@@ -1,0 +1,15 @@
+"""analytics_zoo_tpu: a TPU-native analytics + AI framework.
+
+A ground-up rebuild of the capabilities of Analytics Zoo (reference:
+/root/reference, Intel Analytics Zoo ~v0.3.0) designed for TPU hardware:
+JAX/XLA compute, pjit/Mesh SPMD parallelism, pallas kernels for hot ops,
+and a functional layer/graph core in place of the JVM/BigDL engine.
+"""
+
+__version__ = "0.1.0"
+
+from .common.context import (NNContext, ZooTpuConfig, init_nncontext,
+                             initNNContext, get_nncontext, reset_nncontext)
+from .core.graph import Input, Variable, GraphModule
+from .core.module import Layer
+from .data.dataset import Dataset

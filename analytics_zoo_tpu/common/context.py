@@ -1,0 +1,109 @@
+"""Context initialization: the TPU-native ``init_nncontext`` equivalent.
+
+Parity surface: reference ``NNContext.initNNContext`` / python
+``init_nncontext`` (zoo/.../common/NNContext.scala:132-206,
+pyzoo/zoo/common/nncontext.py:21-40): conf injection + engine init + version
+check.  On TPU the "context" is {platform, mesh, typed config}; there is no
+SparkContext and no 5-layer conf sprawl (SURVEY §5 flags this) — one typed
+``ZooTpuConfig`` object replaces bundled-conf-file + sys-props + env-var
+layering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Dict, Optional
+
+import jax
+
+from ..parallel import mesh as mesh_lib
+
+log = logging.getLogger("analytics_zoo_tpu")
+
+__version__ = "0.1.0"
+
+
+@dataclasses.dataclass
+class ZooTpuConfig:
+    """Typed configuration (replaces spark-analytics-zoo.conf injection)."""
+
+    app_name: str = "analytics-zoo-tpu"
+    mesh_axes: Optional[Dict[str, int]] = None  # None -> all devices on data
+    compute_dtype: str = "float32"  # "bfloat16" for MXU-native training
+    seed: int = 0
+    log_level: str = "INFO"
+    version_check: bool = False  # parity: spark.analytics.zoo.versionCheck
+
+
+class NNContext:
+    """Holds the device mesh + config for a session."""
+
+    def __init__(self, conf: ZooTpuConfig, mesh):
+        self.conf = conf
+        self.mesh = mesh
+        self.app_name = conf.app_name
+
+    @property
+    def devices(self):
+        return list(self.mesh.devices.flat)
+
+    @property
+    def device_count(self):
+        return len(self.devices)
+
+    def __repr__(self):
+        return (f"NNContext(app={self.app_name!r}, "
+                f"platform={self.devices[0].platform}, "
+                f"mesh={dict(self.mesh.shape)})")
+
+
+_CONTEXT: Optional[NNContext] = None
+
+
+def init_nncontext(conf: Optional[ZooTpuConfig] = None,
+                   app_name: Optional[str] = None) -> NNContext:
+    """Create (or return) the process-wide context.
+
+    Mirrors the getOrCreate semantics of the reference
+    (NNContext.scala:132-146): repeated calls return the same context.
+    """
+    global _CONTEXT
+    if _CONTEXT is not None:
+        return _CONTEXT
+    conf = conf or ZooTpuConfig()
+    if app_name:
+        conf.app_name = app_name
+    logging.basicConfig(level=getattr(logging, conf.log_level, logging.INFO))
+    if conf.version_check:
+        check_version()
+    mesh = mesh_lib.create_mesh(conf.mesh_axes)
+    mesh_lib.set_default_mesh(mesh)
+    log.info("initNNContext: %d %s device(s), mesh %s",
+             len(jax.devices()), jax.devices()[0].platform,
+             dict(mesh.shape))
+    _CONTEXT = NNContext(conf, mesh)
+    return _CONTEXT
+
+
+# parity alias with the scala camelCase entry point
+initNNContext = init_nncontext
+
+
+def get_nncontext() -> Optional[NNContext]:
+    return _CONTEXT
+
+
+def reset_nncontext():
+    global _CONTEXT
+    _CONTEXT = None
+    mesh_lib.set_default_mesh(None)
+
+
+def check_version():
+    """Compile-time vs runtime version check parity
+    (NNContext.scala:78-130 ZooBuildInfo)."""
+    import jax as _jax
+    log.info("analytics-zoo-tpu %s on jax %s", __version__, _jax.__version__)
+    return __version__

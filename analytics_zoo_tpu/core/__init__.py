@@ -1,0 +1,3 @@
+from .module import Layer, register_layer, get_layer_class
+from .graph import Variable, Input, GraphModule
+from . import shapes, initializers

@@ -1,0 +1,286 @@
+"""Symbolic graph: ``Variable`` nodes + ``GraphModule`` evaluation.
+
+One graph engine backs BOTH user-facing surfaces of the reference:
+
+* the Keras functional API — ``Model(input, output)`` over layer calls
+  (reference: zoo/.../pipeline/api/keras/models/Topology.scala:509-714), and
+* the autograd DSL — ``Variable`` operator overloads, ``Parameter``,
+  ``CustomLoss`` (reference: zoo/.../pipeline/api/autograd/math.scala:341-567).
+
+The reference implements these as two distinct wrappers over BigDL graph
+nodes whose "autodiff" is each wrapped module's hand-written backward.  Here a
+``Variable`` is a lightweight symbolic node; a ``GraphModule`` topologically
+evaluates the node graph as one pure JAX function, so ``jax.grad`` provides
+real reverse-mode autodiff through arbitrary user expressions and the whole
+graph jits into a single XLA computation.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import shapes as shape_utils
+from .module import Layer, Params, State, fresh_name, register_layer, split_rng
+
+_NODE_IDS = itertools.count()
+
+
+def broadcast_shapes(a, b):
+    """Numpy-style broadcast of two batch shapes where ``None`` = unknown."""
+    la, lb = len(a), len(b)
+    n = max(la, lb)
+    a = (1,) * (n - la) + tuple(a)
+    b = (1,) * (n - lb) + tuple(b)
+    out = []
+    for da, db in zip(a, b):
+        if da is None or db is None:
+            out.append(None if (da in (1, None) and db in (1, None)) else
+                       (da if da not in (1, None) else db))
+        elif da == 1:
+            out.append(db)
+        elif db == 1 or da == db:
+            out.append(da)
+        else:
+            raise ValueError(f"Cannot broadcast shapes {a} and {b}")
+    return tuple(out)
+
+
+class Variable:
+    """A symbolic tensor: the output of a layer applied to other Variables."""
+
+    def __init__(self, layer: Optional[Layer], inputs: Sequence["Variable"],
+                 shape, name: Optional[str] = None):
+        self.layer = layer
+        self.inputs: Tuple["Variable", ...] = tuple(inputs)
+        self.shape = tuple(shape)
+        self.node_id = next(_NODE_IDS)
+        self.name = name or (layer.name if layer is not None
+                             else fresh_name("input"))
+
+    # -- construction --------------------------------------------------
+    @staticmethod
+    def from_layer(layer: Layer, x) -> "Variable":
+        xs = list(x) if isinstance(x, (list, tuple)) else [x]
+        for v in xs:
+            if not isinstance(v, Variable):
+                raise TypeError(
+                    f"Layer {layer.name} called on non-Variable {type(v)}; "
+                    "wrap constants with autograd.constant()")
+        in_shape = [v.shape for v in xs] if len(xs) > 1 else xs[0].shape
+        out_shape = layer.compute_output_shape(in_shape)
+        return Variable(layer, xs, out_shape)
+
+    # -- graph traversal ----------------------------------------------
+    def ancestors(self) -> List["Variable"]:
+        """All nodes reachable from self, in topological order."""
+        order, seen = [], set()
+
+        def visit(v):
+            if v.node_id in seen:
+                return
+            seen.add(v.node_id)
+            for p in v.inputs:
+                visit(p)
+            order.append(v)
+
+        visit(self)
+        return order
+
+    # -- operator overloads (implemented by ops.py via monkey-wiring) --
+    def __add__(self, other):
+        from ..ops import elementwise as E
+        return E.add(self, other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        from ..ops import elementwise as E
+        return E.sub(self, other)
+
+    def __rsub__(self, other):
+        from ..ops import elementwise as E
+        return E.sub(other, self)
+
+    def __mul__(self, other):
+        from ..ops import elementwise as E
+        return E.mul(self, other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        from ..ops import elementwise as E
+        return E.div(self, other)
+
+    def __rtruediv__(self, other):
+        from ..ops import elementwise as E
+        return E.div(other, self)
+
+    def __neg__(self):
+        from ..ops import elementwise as E
+        return E.neg(self)
+
+    def __pow__(self, p):
+        from ..ops import elementwise as E
+        return E.pow(self, p)
+
+    def __getitem__(self, item):
+        from ..ops import elementwise as E
+        return E.getitem(self, item)
+
+    # reference parity: Variable.slice / indexSelect / squeeze
+    # (math.scala:484-530)
+    def slice(self, dim, start_index, length):
+        from ..ops import elementwise as E
+        return E.slice(self, dim, start_index, length)
+
+    def index_select(self, dim, index):
+        from ..ops import elementwise as E
+        return E.index_select(self, dim, index)
+
+    def squeeze(self, dim):
+        from ..ops import elementwise as E
+        return E.squeeze(self, dim)
+
+    def __repr__(self):
+        return f"Variable({self.name}, shape={self.shape})"
+
+
+@register_layer
+class InputLayer(Layer):
+    """Placeholder layer marking a graph input."""
+
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return inputs
+
+    def get_config(self):
+        return super().get_config()
+
+
+def Input(shape, name=None) -> Variable:
+    """Create a graph input Variable with per-sample ``shape``."""
+    layer = InputLayer(input_shape=shape, name=name)
+    return Variable(layer, (), shape_utils.to_batch_shape(shape),
+                    name=layer.name)
+
+
+class GraphModule(Layer):
+    """A Layer evaluating a Variable graph from ``inputs`` to ``outputs``.
+
+    Weight sharing falls out naturally: a layer instance appearing at several
+    nodes contributes one params entry (keyed by its unique name).
+    """
+
+    stateful = True
+    stochastic = True
+
+    def __init__(self, inputs, outputs, name=None):
+        super().__init__(name=name)
+        self.input_vars: List[Variable] = (
+            list(inputs) if isinstance(inputs, (list, tuple)) else [inputs])
+        self.output_vars: List[Variable] = (
+            list(outputs) if isinstance(outputs, (list, tuple)) else [outputs])
+        self.single_output = not isinstance(outputs, (list, tuple))
+
+        # topological order over the union of all output ancestries
+        seen: Dict[int, Variable] = {}
+        self.nodes: List[Variable] = []
+        for out in self.output_vars:
+            for v in out.ancestors():
+                if v.node_id not in seen:
+                    seen[v.node_id] = v
+                    self.nodes.append(v)
+        input_ids = {v.node_id for v in self.input_vars}
+        for v in self.nodes:
+            if not v.inputs and v.node_id not in input_ids and not isinstance(
+                    v.layer, InputLayer) and not getattr(
+                        v.layer, "is_source", False):
+                raise ValueError(
+                    f"Graph node {v.name} has no inputs and is not a graph "
+                    "input / Parameter / constant")
+
+        # one entry per distinct layer instance, in first-use order
+        self.layers: List[Layer] = []
+        layer_ids = set()
+        for v in self.nodes:
+            if v.layer is not None and id(v.layer) not in layer_ids \
+                    and not isinstance(v.layer, InputLayer):
+                layer_ids.add(id(v.layer))
+                self.layers.append(v.layer)
+
+    # ----- functional contract -----
+    def init(self, rng, input_shape=None) -> Tuple[Params, State]:
+        params: Params = {}
+        state: State = {}
+        rngs = split_rng(rng, max(len(self.layers), 1))
+        # first-use input shape per layer instance
+        shaped = {}
+        for v in self.nodes:
+            if v.layer is None or isinstance(v.layer, InputLayer):
+                continue
+            if id(v.layer) not in shaped:
+                ins = ([p.shape for p in v.inputs] if len(v.inputs) > 1
+                       else (v.inputs[0].shape if v.inputs else None))
+                shaped[id(v.layer)] = ins
+        for r, layer in zip(rngs, self.layers):
+            p, s = layer.init(r, shaped[id(layer)])
+            if p:
+                params[layer.name] = p
+            if s:
+                state[layer.name] = s
+        return params, state
+
+    def init_params(self, rng, input_shape):  # pragma: no cover - init() used
+        return self.init(rng, input_shape)[0]
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        xs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        if len(xs) != len(self.input_vars):
+            raise ValueError(
+                f"{self.name}: expected {len(self.input_vars)} inputs, "
+                f"got {len(xs)}")
+        values: Dict[int, Any] = {
+            v.node_id: x for v, x in zip(self.input_vars, xs)}
+        new_state = dict(state)
+        rngs = iter(split_rng(rng, len(self.nodes)))
+        for v in self.nodes:
+            r = next(rngs)
+            if v.node_id in values:
+                continue
+            if isinstance(v.layer, InputLayer):
+                raise ValueError(
+                    f"Graph input {v.name} was not fed "
+                    f"(inputs given: {[iv.name for iv in self.input_vars]})")
+            layer = v.layer
+            ins = ([values[p.node_id] for p in v.inputs] if len(v.inputs) > 1
+                   else (values[v.inputs[0].node_id] if v.inputs else ()))
+            p = params.get(layer.name, {})
+            s = state.get(layer.name, {})
+            out, s_new = layer.apply(p, s, ins, training=training, rng=r)
+            if layer.stateful and s_new:
+                new_state[layer.name] = s_new
+            values[v.node_id] = out
+        outs = [values[v.node_id] for v in self.output_vars]
+        return (outs[0] if self.single_output else outs), new_state
+
+    def apply(self, params, state, inputs, training=False, rng=None):
+        return self.call(params, state, inputs, training=training, rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        if self.single_output:
+            return self.output_vars[0].shape
+        return [v.shape for v in self.output_vars]
+
+    @property
+    def input_shapes(self):
+        return [v.shape for v in self.input_vars]
+
+    @property
+    def output_shapes(self):
+        return [v.shape for v in self.output_vars]

@@ -1,0 +1,114 @@
+"""Weight initializers (Keras-1 ``init=`` string surface).
+
+Reference exposes these as string args on every layer
+(e.g. ``init="glorot_uniform"`` on Dense, reference:
+zoo/.../pipeline/api/keras/layers/Dense.scala).  Implemented directly over
+``jax.random`` so inits run on-device and are jit-safe.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape):
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    elif len(shape) in (3, 4, 5):
+        receptive = int(np.prod(shape[:-2]))
+        fan_in = shape[-2] * receptive
+        fan_out = shape[-1] * receptive
+    else:
+        fan_in = fan_out = int(np.sqrt(np.prod(shape)))
+    return fan_in, fan_out
+
+
+def glorot_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = np.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def glorot_normal(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    std = np.sqrt(2.0 / (fan_in + fan_out))
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def he_normal(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    return np.sqrt(2.0 / fan_in) * jax.random.normal(rng, shape, dtype)
+
+
+def he_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    limit = np.sqrt(6.0 / fan_in)
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def lecun_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    limit = np.sqrt(3.0 / fan_in)
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def uniform(rng, shape, dtype=jnp.float32, scale=0.05):
+    return jax.random.uniform(rng, shape, dtype, -scale, scale)
+
+
+def normal(rng, shape, dtype=jnp.float32, scale=0.05):
+    return scale * jax.random.normal(rng, shape, dtype)
+
+
+def zero(rng, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def one(rng, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def identity(rng, shape, dtype=jnp.float32):
+    if len(shape) != 2 or shape[0] != shape[1]:
+        raise ValueError("identity init requires a square 2D shape")
+    return jnp.eye(shape[0], dtype=dtype)
+
+
+def orthogonal(rng, shape, dtype=jnp.float32):
+    flat = (shape[0], int(np.prod(shape[1:])))
+    a = jax.random.normal(rng, flat, jnp.float32)
+    q, r = jnp.linalg.qr(a.T if flat[0] < flat[1] else a)
+    q = q * jnp.sign(jnp.diagonal(r))
+    q = q.T if flat[0] < flat[1] else q
+    return q.reshape(shape).astype(dtype)
+
+
+_INITS = {
+    "glorot_uniform": glorot_uniform,
+    "glorot_normal": glorot_normal,
+    "xavier": glorot_uniform,
+    "he_normal": he_normal,
+    "he_uniform": he_uniform,
+    "lecun_uniform": lecun_uniform,
+    "uniform": uniform,
+    "normal": normal,
+    "gaussian": normal,
+    "zero": zero,
+    "zeros": zero,
+    "one": one,
+    "ones": one,
+    "identity": identity,
+    "orthogonal": orthogonal,
+}
+
+
+def get(name):
+    """Resolve an initializer by name (or pass a callable through)."""
+    if callable(name):
+        return name
+    try:
+        return _INITS[name]
+    except KeyError:
+        raise ValueError(f"Unknown initializer {name!r}; known: {sorted(_INITS)}")

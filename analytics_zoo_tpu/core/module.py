@@ -1,0 +1,135 @@
+"""Layer: the functional module contract of the TPU-native framework.
+
+The reference framework's layer contract is an object-oriented
+forward/backward pair (``updateOutput`` / ``updateGradInput`` /
+``accGradParameters``; reference: zoo/.../pipeline/api/net/TFNet.scala:201-417
+implements it for the TF bridge, every Keras layer wraps a BigDL module with
+it).  On TPU the backward pass comes from ``jax.grad``, so a layer here is a
+pure pair:
+
+    init(rng, input_shape)                  -> (params, state)
+    apply(params, state, inputs, training, rng) -> (outputs, new_state)
+
+``params`` are trainable pytrees, ``state`` holds non-trained buffers
+(BatchNorm moving stats).  Both are plain dicts of jnp arrays so the whole
+model is a pytree that `jit`/`pjit` shard transparently.
+
+Shape inference stays eager-Python (``compute_output_shape``) so every traced
+program has static shapes — the precondition for MXU-friendly XLA tiling.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from . import shapes as shape_utils
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+_LAYER_REGISTRY: Dict[str, type] = {}
+_NAME_COUNTERS: "collections.Counter" = collections.Counter()
+
+
+def register_layer(cls):
+    """Class decorator: register for config-based (de)serialization."""
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def get_layer_class(name: str) -> type:
+    if name not in _LAYER_REGISTRY:
+        raise KeyError(
+            f"Unknown layer class {name!r}; known: {sorted(_LAYER_REGISTRY)}"
+        )
+    return _LAYER_REGISTRY[name]
+
+
+def fresh_name(prefix: str) -> str:
+    _NAME_COUNTERS[prefix] += 1
+    return f"{prefix}_{_NAME_COUNTERS[prefix]}"
+
+
+class Layer:
+    """Base class for all layers.
+
+    Subclasses implement ``init_params``, ``call`` and
+    ``compute_output_shape``; stateful layers additionally use
+    ``init_state`` and return updated state from ``call``.
+    """
+
+    #: set True on layers whose ``call`` consumes an rng when training
+    stochastic: bool = False
+    #: set True on layers carrying non-trainable state (e.g. BatchNorm)
+    stateful: bool = False
+
+    def __init__(self, input_shape=None, name: Optional[str] = None, **kwargs):
+        self.name = name or fresh_name(type(self).__name__.lower())
+        self.batch_input_shape: Optional[shape_utils.Shape] = (
+            shape_utils.to_batch_shape(input_shape) if input_shape else None
+        )
+        self.trainable = kwargs.pop("trainable", True)
+        if kwargs:
+            raise TypeError(f"{type(self).__name__}: unexpected kwargs {kwargs}")
+
+    # ---- symbolic graph building (functional API / autograd DSL) ----
+    def __call__(self, x):
+        from .graph import Variable  # local import to avoid cycle
+
+        return Variable.from_layer(self, x)
+
+    # ---- functional contract ----
+    def init(self, rng, input_shape) -> Tuple[Params, State]:
+        self._check_input_shape(input_shape)
+        params = self.init_params(rng, input_shape)
+        state = self.init_state(input_shape)
+        return params, state
+
+    def init_params(self, rng, input_shape) -> Params:
+        return {}
+
+    def init_state(self, input_shape) -> State:
+        return {}
+
+    def apply(self, params, state, inputs, training: bool = False, rng=None):
+        out = self.call(params, state, inputs, training=training, rng=rng)
+        if self.stateful:
+            return out  # (outputs, new_state)
+        return out, state
+
+    def call(self, params, state, inputs, training: bool = False, rng=None):
+        raise NotImplementedError
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+    def _check_input_shape(self, input_shape):
+        pass
+
+    # ---- serialization ----
+    def get_config(self) -> dict:
+        cfg = {"name": self.name}
+        if self.batch_input_shape is not None:
+            cfg["input_shape"] = list(self.batch_input_shape[1:])
+        return cfg
+
+    @classmethod
+    def from_config(cls, config: dict) -> "Layer":
+        return cls(**config)
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name}>"
+
+    # ---- parameter bookkeeping helpers ----
+    def param_count(self, params: Params) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def split_rng(rng, n: int):
+    if rng is None:
+        return [None] * n
+    return list(jax.random.split(rng, n))

@@ -1,0 +1,112 @@
+"""Shape utilities for Keras-1-style shape inference.
+
+The reference framework infers output shapes layer-by-layer from a
+``build(inputShape) -> outputShape`` contract (reference:
+zoo/.../pipeline/api/keras/models/Topology.scala:722-742).  Here shapes are
+plain tuples whose leading batch dimension is ``None``; all inference is done
+eagerly in Python so that the resulting JAX program has fully static shapes
+(an XLA requirement for TPU compilation).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+Shape = Tuple[Optional[int], ...]
+
+
+def to_batch_shape(input_shape: Sequence[Optional[int]]) -> Shape:
+    """Prepend a ``None`` batch dim to a per-sample shape."""
+    return (None,) + tuple(int(d) for d in input_shape)
+
+
+def drop_batch(shape: Shape) -> Tuple[int, ...]:
+    return tuple(shape[1:])
+
+
+def is_shape(x) -> bool:
+    return isinstance(x, (tuple, list)) and all(
+        d is None or isinstance(d, int) for d in x
+    )
+
+
+def merge_batch(shapes: Sequence[Shape]) -> Optional[int]:
+    """Return the common batch dim of several shapes (None if unknown)."""
+    batch = None
+    for s in shapes:
+        if s and s[0] is not None:
+            if batch is not None and batch != s[0]:
+                raise ValueError(f"Incompatible batch dims: {batch} vs {s[0]}")
+            batch = s[0]
+    return batch
+
+
+def conv_output_length(
+    input_length: Optional[int],
+    filter_size: int,
+    border_mode: str,
+    stride: int,
+    dilation: int = 1,
+) -> Optional[int]:
+    """Keras-1 convolution length arithmetic (border_mode in {same, valid, full, causal})."""
+    if input_length is None:
+        return None
+    dilated = filter_size + (filter_size - 1) * (dilation - 1)
+    if border_mode in ("same", "causal"):
+        out = input_length
+    elif border_mode == "valid":
+        out = input_length - dilated + 1
+    elif border_mode == "full":
+        out = input_length + dilated - 1
+    else:
+        raise ValueError(f"Unknown border_mode {border_mode!r}")
+    return (out + stride - 1) // stride
+
+
+def deconv_output_length(
+    input_length: Optional[int], filter_size: int, border_mode: str, stride: int
+) -> Optional[int]:
+    if input_length is None:
+        return None
+    out = input_length * stride
+    if border_mode == "valid":
+        out += max(filter_size - stride, 0)
+    return out
+
+
+def pool_output_length(
+    input_length: Optional[int], pool_size: int, border_mode: str, stride: int
+) -> Optional[int]:
+    if input_length is None:
+        return None
+    if border_mode == "same":
+        return math.ceil(input_length / stride)
+    return (input_length - pool_size) // stride + 1
+
+
+def normalize_tuple(value, n: int, name: str = "value") -> Tuple[int, ...]:
+    """Accept int or length-n sequence; return an n-tuple of ints."""
+    if isinstance(value, int):
+        return (value,) * n
+    value = tuple(int(v) for v in value)
+    if len(value) != n:
+        raise ValueError(f"{name} must be an int or length-{n} tuple, got {value}")
+    return value
+
+
+def normalize_data_format(value: Optional[str]) -> str:
+    """Map Keras-1 dim_ordering / Keras-2 data_format spellings to canonical form.
+
+    TPU-native default is channels_last (NHWC maps cleanly onto XLA:TPU
+    convolution layouts); ``th``/``channels_first`` inputs are accepted for
+    API parity with the reference and transposed at the layer boundary.
+    """
+    if value is None:
+        return "channels_last"
+    v = value.lower()
+    if v in ("tf", "channels_last", "nhwc"):
+        return "channels_last"
+    if v in ("th", "channels_first", "nchw"):
+        return "channels_first"
+    raise ValueError(f"Unknown data format {value!r}")

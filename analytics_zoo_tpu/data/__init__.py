@@ -1,0 +1,1 @@
+from .dataset import Dataset, check_batch_divisibility, shard_batch

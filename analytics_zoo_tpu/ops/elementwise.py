@@ -1,0 +1,435 @@
+"""Primitive symbolic ops over ``Variable`` graphs.
+
+Parity surface: the ``AutoGrad`` op set of the reference (abs, sum, clip,
+square, sqrt, maximum, mean, log, epsilon, exp, pow, softsign, softplus,
+stack, expandDims, contiguous, mm, l2Normalize, batchDot — reference:
+zoo/.../pipeline/api/autograd/math.scala:32-339) plus the Variable operator
+overloads (math.scala:404-530).
+
+Each op is a parameterless ``OpLayer`` node; the underlying computation is a
+registered jnp function, so an expression graph lowers to straight-line jnp
+code that XLA fuses.  Axis convention: axes index the FULL array including the
+batch dimension (jnp semantics) — the reference's implicit-batch convention
+does not survive contact with jit, and full-array axes are what users see in
+every JAX program.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.graph import Variable, broadcast_shapes
+from ..core.module import Layer, register_layer
+from ..core import shapes as shape_utils
+
+_OPS: Dict[str, Callable] = {}
+_SHAPE_FNS: Dict[str, Callable] = {}
+
+
+def def_op(name: str, fn: Callable, shape_fn: Callable = None):
+    _OPS[name] = fn
+    _SHAPE_FNS[name] = shape_fn or (lambda shapes, **kw: shapes[0])
+
+
+@register_layer
+class OpLayer(Layer):
+    """Parameterless node applying a registered op to its inputs."""
+
+    def __init__(self, op=None, op_kwargs=None, name=None, input_shape=None):
+        super().__init__(name=name or None, input_shape=input_shape)
+        self.op = op
+        self.op_kwargs = dict(op_kwargs or {})
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return _OPS[self.op](list(xs), **self.op_kwargs)
+
+    def compute_output_shape(self, input_shape):
+        shapes = (input_shape if isinstance(input_shape[0], (tuple, list))
+                  else [input_shape])
+        return _SHAPE_FNS[self.op]([tuple(s) for s in shapes],
+                                   **self.op_kwargs)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(op=self.op, op_kwargs=self.op_kwargs)
+        return cfg
+
+
+@register_layer
+class ConstantLayer(Layer):
+    """Zero-input node producing a fixed array (graph-captured constant)."""
+
+    is_source = True
+
+    def __init__(self, value=None, name=None, input_shape=None):
+        super().__init__(name=name, input_shape=input_shape)
+        self.value = jnp.asarray(value)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return self.value
+
+    def compute_output_shape(self, input_shape):
+        return tuple(self.value.shape)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["value"] = np.asarray(self.value).tolist()
+        return cfg
+
+
+def constant(value, name=None) -> Variable:
+    layer = ConstantLayer(value=value, name=name)
+    return Variable(layer, (), tuple(jnp.shape(jnp.asarray(value))),
+                    name=layer.name)
+
+
+def _as_variable(x):
+    if isinstance(x, Variable):
+        return x
+    return constant(x)
+
+
+def _apply(op: str, variables, **op_kwargs) -> Variable:
+    vs = [_as_variable(v) for v in variables]
+    layer = OpLayer(op=op, op_kwargs=op_kwargs)
+    return Variable.from_layer(layer, vs if len(vs) > 1 else vs[0])
+
+
+# ---------------- shape helpers ----------------
+
+def _broadcast_shape_fn(shapes, **kw):
+    out = shapes[0]
+    for s in shapes[1:]:
+        out = broadcast_shapes(out, s)
+    return out
+
+
+def _reduce_shape_fn(shapes, axis=None, keepdims=False, **kw):
+    s = list(shapes[0])
+    if axis is None:
+        return () if not keepdims else tuple(1 for _ in s)
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    axes = [a % len(s) for a in axes]
+    if keepdims:
+        for a in axes:
+            s[a] = 1
+        return tuple(s)
+    return tuple(d for i, d in enumerate(s) if i not in axes)
+
+
+# ---------------- binary elementwise ----------------
+
+def_op("add", lambda xs: xs[0] + xs[1], _broadcast_shape_fn)
+def_op("sub", lambda xs: xs[0] - xs[1], _broadcast_shape_fn)
+def_op("mul", lambda xs: xs[0] * xs[1], _broadcast_shape_fn)
+def_op("div", lambda xs: xs[0] / xs[1], _broadcast_shape_fn)
+def_op("maximum", lambda xs: jnp.maximum(xs[0], xs[1]), _broadcast_shape_fn)
+def_op("minimum", lambda xs: jnp.minimum(xs[0], xs[1]), _broadcast_shape_fn)
+
+
+def add(x, y):
+    return _apply("add", [x, y])
+
+
+def sub(x, y):
+    return _apply("sub", [x, y])
+
+
+def mul(x, y):
+    return _apply("mul", [x, y])
+
+
+def div(x, y):
+    return _apply("div", [x, y])
+
+
+def maximum(x, y):
+    return _apply("maximum", [x, y])
+
+
+def minimum(x, y):
+    return _apply("minimum", [x, y])
+
+
+# ---------------- unary ----------------
+
+def_op("neg", lambda xs: -xs[0])
+def_op("abs", lambda xs: jnp.abs(xs[0]))
+def_op("square", lambda xs: jnp.square(xs[0]))
+def_op("sqrt", lambda xs: jnp.sqrt(xs[0]))
+def_op("log", lambda xs: jnp.log(xs[0]))
+def_op("exp", lambda xs: jnp.exp(xs[0]))
+def_op("pow", lambda xs, p=2.0: jnp.power(xs[0], p))
+def_op("softsign", lambda xs: xs[0] / (1.0 + jnp.abs(xs[0])))
+def_op("softplus", lambda xs: jnp.logaddexp(xs[0], 0.0))
+def_op("clip", lambda xs, min=None, max=None: jnp.clip(xs[0], min, max))
+def_op("contiguous", lambda xs: xs[0])
+def_op("relu", lambda xs: jnp.maximum(xs[0], 0.0))
+def_op("sigmoid", lambda xs: 1.0 / (1.0 + jnp.exp(-xs[0])))
+def_op("tanh", lambda xs: jnp.tanh(xs[0]))
+
+
+def neg(x):
+    return _apply("neg", [x])
+
+
+def abs(x):  # noqa: A001 - parity with reference AutoGrad.abs
+    return _apply("abs", [x])
+
+
+def square(x):
+    return _apply("square", [x])
+
+
+def sqrt(x):
+    return _apply("sqrt", [x])
+
+
+def log(x):
+    return _apply("log", [x])
+
+
+def exp(x):
+    return _apply("exp", [x])
+
+
+def pow(x, p):  # noqa: A001
+    return _apply("pow", [x], p=float(p))
+
+
+def softsign(x):
+    return _apply("softsign", [x])
+
+
+def softplus(x):
+    return _apply("softplus", [x])
+
+
+def clip(x, min=None, max=None):  # noqa: A002
+    return _apply("clip", [x], min=min, max=max)
+
+
+def contiguous(x):
+    return _apply("contiguous", [x])
+
+
+def relu(x):
+    return _apply("relu", [x])
+
+
+def sigmoid(x):
+    return _apply("sigmoid", [x])
+
+
+def tanh(x):
+    return _apply("tanh", [x])
+
+
+def epsilon():
+    """Fuzz factor, parity with AutoGrad.epsilon (math.scala:116)."""
+    return 1e-7
+
+
+# ---------------- reductions ----------------
+
+def_op("sum", lambda xs, axis=None, keepdims=False:
+       jnp.sum(xs[0], axis=axis, keepdims=keepdims), _reduce_shape_fn)
+def_op("mean", lambda xs, axis=None, keepdims=False:
+       jnp.mean(xs[0], axis=axis, keepdims=keepdims), _reduce_shape_fn)
+def_op("max", lambda xs, axis=None, keepdims=False:
+       jnp.max(xs[0], axis=axis, keepdims=keepdims), _reduce_shape_fn)
+def_op("min", lambda xs, axis=None, keepdims=False:
+       jnp.min(xs[0], axis=axis, keepdims=keepdims), _reduce_shape_fn)
+
+
+def sum(x, axis=None, keepdims=False):  # noqa: A001
+    return _apply("sum", [x], axis=axis, keepdims=keepdims)
+
+
+def mean(x, axis=None, keepdims=False):
+    return _apply("mean", [x], axis=axis, keepdims=keepdims)
+
+
+def max(x, axis=None, keepdims=False):  # noqa: A001
+    return _apply("max", [x], axis=axis, keepdims=keepdims)
+
+
+def min(x, axis=None, keepdims=False):  # noqa: A001
+    return _apply("min", [x], axis=axis, keepdims=keepdims)
+
+
+# ---------------- shape manipulation ----------------
+
+def _expand_dims_shape(shapes, axis=0, **kw):
+    s = list(shapes[0])
+    a = axis if axis >= 0 else len(s) + 1 + axis
+    s.insert(a, 1)
+    return tuple(s)
+
+
+def _squeeze_shape(shapes, axis=None, **kw):
+    s = list(shapes[0])
+    a = axis % len(s)
+    if s[a] not in (1, None):
+        raise ValueError(f"Cannot squeeze axis {axis} of shape {shapes[0]}")
+    return tuple(d for i, d in enumerate(s) if i != a)
+
+
+def_op("expand_dims", lambda xs, axis=0: jnp.expand_dims(xs[0], axis),
+       _expand_dims_shape)
+def_op("squeeze", lambda xs, axis=None: jnp.squeeze(xs[0], axis),
+       _squeeze_shape)
+
+
+def expand_dims(x, axis=0):
+    return _apply("expand_dims", [x], axis=axis)
+
+
+def squeeze(x, axis):
+    return _apply("squeeze", [x], axis=axis)
+
+
+def _stack_shape(shapes, axis=0, **kw):
+    s = list(shapes[0])
+    a = axis if axis >= 0 else len(s) + 1 + axis
+    s.insert(a, len(shapes))
+    return tuple(s)
+
+
+def_op("stack", lambda xs, axis=0: jnp.stack(xs, axis=axis), _stack_shape)
+
+
+def stack(variables, axis=0):
+    return _apply("stack", list(variables), axis=axis)
+
+
+def _concat_shape(shapes, axis=-1, **kw):
+    s = list(shapes[0])
+    a = axis % len(s)
+    total = 0
+    for sh in shapes:
+        if sh[a] is None:
+            total = None
+            break
+        total += sh[a]
+    s[a] = total
+    return tuple(s)
+
+
+def_op("concat", lambda xs, axis=-1: jnp.concatenate(xs, axis=axis),
+       _concat_shape)
+
+
+def concat(variables, axis=-1):
+    return _apply("concat", list(variables), axis=axis)
+
+
+def _slice_shape(shapes, dim=0, start=0, length=1, **kw):
+    s = list(shapes[0])
+    s[dim % len(s)] = length
+    return tuple(s)
+
+
+def_op("slice", lambda xs, dim=0, start=0, length=1:
+       jnp.take(xs[0], jnp.arange(start, start + length), axis=dim),
+       _slice_shape)
+
+
+def slice(x, dim, start_index, length):  # noqa: A001
+    return _apply("slice", [x], dim=dim, start=start_index, length=length)
+
+
+def _index_select_shape(shapes, dim=0, index=0, **kw):
+    s = list(shapes[0])
+    del s[dim % len(s)]
+    return tuple(s)
+
+
+def_op("index_select", lambda xs, dim=0, index=0:
+       jnp.take(xs[0], index, axis=dim), _index_select_shape)
+
+
+def index_select(x, dim, index):
+    return _apply("index_select", [x], dim=dim, index=index)
+
+
+def _getitem_shape(shapes, item=None, **kw):
+    probe = np.zeros([d if d is not None else 2 for d in shapes[0]])
+    out = probe[_decode_item(item)].shape
+    # restore None batch if the batch axis survived a full slice
+    if (shapes[0] and shapes[0][0] is None and isinstance(item, (list, tuple))
+            and item and item[0] == ["slice", None, None, None]):
+        out = (None,) + tuple(out[1:])
+    return tuple(out)
+
+
+def _encode_item(item):
+    items = item if isinstance(item, tuple) else (item,)
+    enc = []
+    for it in items:
+        if isinstance(it, builtins.slice):
+            enc.append(["slice", it.start, it.stop, it.step])
+        else:
+            enc.append(int(it))
+    return enc
+
+
+def _decode_item(enc):
+    out = []
+    for it in enc:
+        if isinstance(it, (list, tuple)) and it and it[0] == "slice":
+            out.append(builtins.slice(it[1], it[2], it[3]))
+        else:
+            out.append(it)
+    return tuple(out)
+
+
+def_op("getitem", lambda xs, item=None: xs[0][_decode_item(item)],
+       _getitem_shape)
+
+
+def getitem(x, item):
+    return _apply("getitem", [x], item=_encode_item(item))
+
+
+# ---------------- linear algebra ----------------
+
+def _mm_shape(shapes, axes=None, **kw):
+    a, b = shapes
+    return tuple(a[:-1]) + (b[-1],)
+
+
+def_op("mm", lambda xs, axes=None: jnp.matmul(xs[0], xs[1]), _mm_shape)
+
+
+def mm(x, y, axes=None):
+    """Matrix multiply (reference AutoGrad.mm, math.scala:230)."""
+    return _apply("mm", [x, y])
+
+
+def _batch_dot_shape(shapes, axes=None, **kw):
+    a, b = shapes
+    return tuple(a[:-1]) + (b[-1],)
+
+
+def_op("batch_dot",
+       lambda xs, axes=None: jnp.einsum("b...ik,b...kj->b...ij", xs[0], xs[1]),
+       _batch_dot_shape)
+
+
+def batch_dot(x, y, axes=None):
+    return _apply("batch_dot", [x, y])
+
+
+def_op("l2_normalize", lambda xs, axis=-1:
+       xs[0] / jnp.sqrt(jnp.maximum(
+           jnp.sum(jnp.square(xs[0]), axis=axis, keepdims=True), 1e-12)))
+
+
+def l2_normalize(x, axis=-1):
+    return _apply("l2_normalize", [x], axis=axis)

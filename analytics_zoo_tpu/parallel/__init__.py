@@ -1,0 +1,3 @@
+from .mesh import (create_mesh, data_sharding, replicated, dp_size,
+                   get_default_mesh, set_default_mesh)
+from . import sharding

@@ -1,0 +1,2 @@
+from .engine import Sequential, Model, KerasNet, load_model
+from . import objectives, metrics, optimizers, activations

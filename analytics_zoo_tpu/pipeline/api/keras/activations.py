@@ -1,0 +1,94 @@
+"""Activation functions resolvable by Keras-1 name strings.
+
+Parity: the activation strings accepted across the reference layer set
+(reference: zoo/.../pipeline/api/keras/layers/utils/KerasUtils.scala maps the
+same strings to BigDL modules).  All are jnp elementwise ops that XLA fuses
+into the producing matmul/conv — no standalone kernels needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def linear(x):
+    return x
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hard_sigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def log_softmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def elu(x):
+    return jax.nn.elu(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+_ACTIVATIONS = {
+    "linear": linear,
+    "relu": relu,
+    "relu6": relu6,
+    "tanh": tanh,
+    "sigmoid": sigmoid,
+    "hard_sigmoid": hard_sigmoid,
+    "softmax": softmax,
+    "log_softmax": log_softmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "elu": elu,
+    "gelu": gelu,
+    "silu": silu,
+    "swish": silu,
+}
+
+
+def get(name):
+    if name is None:
+        return None
+    if callable(name):
+        return name
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}")

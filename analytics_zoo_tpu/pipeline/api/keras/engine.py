@@ -1,0 +1,343 @@
+"""KerasNet / Sequential / Model: the user-facing training lifecycle.
+
+Parity surface: reference zoo/.../pipeline/api/keras/models/Topology.scala —
+``compile`` (:107-141), ``fit`` (:255-330), ``evaluate`` (:353),
+``predict``/``predictClasses`` (:393-469), ``setTensorBoard`` (:167),
+``setCheckpoint`` (:184), gradient clipping (:200-230), Sequential ``add``
+(:768), functional Model over Variables (:653-689), plus saveModel/loadModel
+(ZooModel.scala:78-124).
+
+The lifecycle holds a Trainer (train/trainer.py) the way the reference holds
+a BigDL Optimizer; incremental fit works because the Trainer keeps epoch
+state across calls (Topology.scala:839-894 InternalOptimizer glue is
+unnecessary — state is explicit here).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+
+from ....core.graph import GraphModule, Input, Variable
+from ....core.module import Layer, get_layer_class, register_layer
+from ....data.dataset import Dataset
+from ....train import triggers as trigger_lib
+from ....train.trainer import Trainer
+from . import metrics as metrics_lib
+from . import objectives as objectives_lib
+from . import optimizers as optimizers_lib
+
+
+class KerasNet(Layer):
+    """Abstract compiled-model lifecycle shared by Sequential and Model."""
+
+    stateful = True
+    stochastic = True
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self.trainer: Optional[Trainer] = None
+        self._compile_args: Optional[dict] = None
+        self._tensorboard: Optional[tuple] = None
+        self._checkpoint: Optional[tuple] = None
+        self._clip_norm = None
+        self._clip_value = None
+
+    # ---- to be provided by subclasses ----
+    def to_graph(self) -> GraphModule:
+        raise NotImplementedError
+
+    # ---- compile/fit lifecycle (Topology.scala:107-330) ----
+    def compile(self, optimizer, loss, metrics: Sequence = (),
+                mesh=None, strategy: str = "replicate", seed: int = 0,
+                compute_dtype=None):
+        loss_fn = objectives_lib.get(loss)
+        opt = optimizers_lib.get(optimizer, clip_norm=self._clip_norm,
+                                 clip_value=self._clip_value)
+        metric_objs = [metrics_lib.get(m) for m in metrics]
+        self.trainer = Trainer(self.to_graph(), loss_fn, opt,
+                               metrics=metric_objs, mesh=mesh,
+                               strategy=strategy, seed=seed,
+                               compute_dtype=compute_dtype)
+        if self._tensorboard:
+            self.trainer.set_tensorboard(*self._tensorboard)
+        if self._checkpoint:
+            self.trainer.set_checkpoint(*self._checkpoint)
+        self._compile_args = {"optimizer": optimizer, "loss": loss,
+                              "metrics": list(metrics)}
+        return self
+
+    def set_tensorboard(self, log_dir: str, app_name: str):
+        self._tensorboard = (log_dir, app_name)
+        if self.trainer is not None:
+            self.trainer.set_tensorboard(log_dir, app_name)
+
+    def set_checkpoint(self, path: str, over_write: bool = True):
+        self._checkpoint = (path, over_write)
+        if self.trainer is not None:
+            self.trainer.set_checkpoint(path, over_write)
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float):
+        """Parity: Topology.scala:219-224; call before compile."""
+        self._clip_norm = float(clip_norm)
+
+    def set_constant_gradient_clipping(self, min_value: float,
+                                       max_value: float):
+        """Parity: Topology.scala:207-213; call before compile."""
+        self._clip_value = (float(min_value), float(max_value))
+
+    def _require_compiled(self):
+        if self.trainer is None:
+            raise RuntimeError(
+                "Model must be compiled before fit/evaluate "
+                "(reference requires compile before fit too)")
+
+    def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 1,
+            validation_data=None, shuffle: bool = True,
+            verbose: bool = False):
+        """x may be a Dataset or ndarray(s); mirrors fit(RDD/ImageSet/
+        DataSet) overloads (Topology.scala:255-330)."""
+        self._require_compiled()
+        ds = x if isinstance(x, Dataset) else Dataset.from_ndarray(x, y)
+        val_ds = None
+        if validation_data is not None:
+            val_ds = (validation_data if isinstance(validation_data, Dataset)
+                      else Dataset.from_ndarray(*validation_data))
+        start_epoch = self.trainer.state.epoch if self.trainer.state else 0
+        return self.trainer.fit(
+            ds, batch_size,
+            end_trigger=trigger_lib.MaxEpoch(start_epoch + nb_epoch),
+            validation_data=val_ds, shuffle=shuffle, verbose=verbose)
+
+    def evaluate(self, x, y=None, batch_size: int = 32) -> Dict[str, float]:
+        self._require_compiled()
+        ds = x if isinstance(x, Dataset) else Dataset.from_ndarray(x, y)
+        return self.trainer.evaluate(ds, batch_size)
+
+    def predict(self, x, batch_size: int = 32, distributed: bool = True):
+        self._require_compiled()
+        return self.trainer.predict(x, batch_size)
+
+    def predict_classes(self, x, batch_size: int = 32,
+                        zero_based_label: bool = True):
+        """Parity: Topology.scala:469 (zero-based label toggle)."""
+        probs = self.predict(x, batch_size)
+        classes = np.argmax(probs, axis=-1)
+        return classes if zero_based_label else classes + 1
+
+    # ---- persistence (ZooModel.scala:78-124) ----
+    def save_model(self, path: str, over_write: bool = True):
+        os.makedirs(path, exist_ok=True)
+        arch = {"class_name": type(self).__name__,
+                "config": self.get_config()}
+        arch_path = os.path.join(path, "architecture.json")
+        if os.path.exists(arch_path) and not over_write:
+            raise FileExistsError(path)
+        with open(arch_path, "w") as f:
+            json.dump(arch, f)
+        if self.trainer is not None and self.trainer.state is not None:
+            self.trainer.save_weights(os.path.join(path, "weights"))
+
+    @staticmethod
+    def load_model(path: str) -> "KerasNet":
+        with open(os.path.join(path, "architecture.json")) as f:
+            arch = json.load(f)
+        cls = _MODEL_CLASSES[arch["class_name"]]
+        model = cls.from_config(arch["config"])
+        weights_dir = os.path.join(path, "weights")
+        if os.path.isdir(weights_dir) and model._compile_args is not None:
+            model.compile(**model._compile_args)
+            model.trainer.ensure_initialized()
+            model.trainer.load_weights(weights_dir)
+        return model
+
+    def get_weights(self):
+        self._require_compiled()
+        self.trainer.ensure_initialized()
+        return jax.device_get(self.trainer.state.params)
+
+    def set_weights(self, params):
+        self._require_compiled()
+        self.trainer.ensure_initialized()
+        self.trainer.state.params = jax.device_put(params)
+
+    # ---- summary (Topology.scala printNodeSummary parity) ----
+    def summary(self) -> str:
+        graph = self.to_graph()
+        lines = [f"Model: {self.name}", "-" * 64]
+        total = 0
+        import jax.numpy as jnp
+        rng = jax.random.PRNGKey(0)
+        params, _ = graph.init(rng)
+        for layer in graph.layers:
+            p = params.get(layer.name, {})
+            count = sum(int(np.prod(np.shape(leaf)))
+                        for leaf in jax.tree_util.tree_leaves(p))
+            total += count
+            lines.append(f"{layer.name:<36} {type(layer).__name__:<20} "
+                         f"params: {count}")
+        lines.append("-" * 64)
+        lines.append(f"Total params: {total}")
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+    # ---- layer delegation so a compiled net can be nested as a Layer ----
+    def init(self, rng, input_shape=None):
+        return self.to_graph().init(rng, input_shape)
+
+    def apply(self, params, state, inputs, training=False, rng=None):
+        return self.to_graph().apply(params, state, inputs,
+                                     training=training, rng=rng)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return self.apply(params, state, inputs, training=training,
+                          rng=rng)[0]
+
+    def compute_output_shape(self, input_shape):
+        return self.to_graph().compute_output_shape(input_shape)
+
+
+@register_layer
+class Sequential(KerasNet):
+    """add()-style container (Topology.scala:716-837)."""
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self._layers: List[Layer] = []
+        self._graph: Optional[GraphModule] = None
+
+    def add(self, layer: Layer) -> "Sequential":
+        if not self._layers and getattr(layer, "batch_input_shape",
+                                        None) is None \
+                and not isinstance(layer, KerasNet):
+            raise ValueError(
+                "First layer needs input_shape (reference Sequential "
+                "requires the same)")
+        self._layers.append(layer)
+        self._graph = None
+        return self
+
+    @property
+    def layers(self):
+        return list(self._layers)
+
+    def to_graph(self) -> GraphModule:
+        if self._graph is None:
+            first = self._layers[0]
+            shape = getattr(first, "batch_input_shape", None)
+            if shape is None and isinstance(first, KerasNet):
+                inner = first.to_graph()
+                shape = inner.input_shapes[0]
+            x = Input(tuple(shape[1:]), name=f"{self.name}_input")
+            h = x
+            for layer in self._layers:
+                if isinstance(layer, KerasNet):
+                    h = layer.to_graph()(h)
+                else:
+                    h = layer(h)
+            self._graph = GraphModule(x, h, name=self.name)
+        return self._graph
+
+    def get_config(self):
+        return {
+            "name": self.name,
+            "layers": [{"class_name": type(l).__name__,
+                        "config": l.get_config()} for l in self._layers],
+            "compile_args": self._compile_args,
+        }
+
+    @classmethod
+    def from_config(cls, config):
+        model = cls(name=config.get("name"))
+        for spec in config["layers"]:
+            layer_cls = get_layer_class(spec["class_name"])
+            model.add(layer_cls.from_config(spec["config"]))
+        model._compile_args = config.get("compile_args")
+        return model
+
+
+@register_layer
+class Model(KerasNet):
+    """Functional graph model over Variables (Topology.scala:509-714)."""
+
+    def __init__(self, input=None, output=None, name=None):
+        super().__init__(name=name)
+        if input is None or output is None:
+            raise ValueError("Model requires input and output Variables")
+        self._graph = GraphModule(input, output, name=self.name)
+        self.inputs = self._graph.input_vars
+        self.outputs = self._graph.output_vars
+
+    def to_graph(self) -> GraphModule:
+        return self._graph
+
+    def new_graph(self, outputs: List[str]) -> "Model":
+        """Graph surgery: re-root on named intermediate outputs
+        (reference GraphNet.new_graph, NetUtils.scala:216-277)."""
+        by_name = {v.name: v for v in self._graph.nodes}
+        outs = [by_name[n] for n in outputs]
+        return Model(input=self._graph.input_vars, output=outs,
+                     name=f"{self.name}_sub")
+
+    def get_config(self):
+        # serialize the node graph: topo-ordered nodes w/ layer configs
+        nodes = []
+        input_ids = [v.node_id for v in self._graph.input_vars]
+        for v in self._graph.nodes:
+            nodes.append({
+                "id": v.node_id,
+                "name": v.name,
+                "layer": None if v.layer is None else {
+                    "class_name": type(v.layer).__name__,
+                    "config": v.layer.get_config()},
+                "inputs": [p.node_id for p in v.inputs],
+                "shape": [d for d in v.shape],
+            })
+        return {"name": self.name, "nodes": nodes,
+                "input_ids": input_ids,
+                "output_ids": [v.node_id for v in self._graph.output_vars],
+                "compile_args": self._compile_args}
+
+    @classmethod
+    def from_config(cls, config):
+        from ....core.graph import InputLayer
+        built: Dict[int, Variable] = {}
+        layer_cache: Dict[str, Layer] = {}
+        for spec in config["nodes"]:
+            if spec["layer"] is None or \
+                    spec["layer"]["class_name"] == "InputLayer":
+                layer_cfg = (spec["layer"] or {}).get("config", {})
+                shape = tuple(layer_cfg.get("input_shape") or
+                              [d for d in spec["shape"][1:]])
+                v = Input(shape, name=spec["name"])
+                built[spec["id"]] = v
+                continue
+            lname = spec["layer"]["config"].get("name", spec["name"])
+            if lname in layer_cache:
+                layer = layer_cache[lname]
+            else:
+                layer_cls = get_layer_class(spec["layer"]["class_name"])
+                layer = layer_cls.from_config(dict(spec["layer"]["config"]))
+                layer_cache[lname] = layer
+            parents = [built[i] for i in spec["inputs"]]
+            built[spec["id"]] = layer(parents if len(parents) > 1
+                                      else parents[0])
+        model = cls(input=[built[i] for i in config["input_ids"]],
+                    output=[built[i] for i in config["output_ids"]],
+                    name=config.get("name"))
+        if len(config["output_ids"]) == 1:
+            model._graph.single_output = True
+        model._compile_args = config.get("compile_args")
+        return model
+
+
+_MODEL_CLASSES = {"Sequential": Sequential, "Model": Model}
+
+
+def load_model(path: str) -> KerasNet:
+    return KerasNet.load_model(path)

@@ -1,0 +1,96 @@
+"""Advanced activation layers.
+
+Parity surface: reference zoo/.../pipeline/api/keras/layers/{ELU, LeakyReLU,
+PReLU, SReLU, ThresholdedReLU}.scala.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.module import Layer, register_layer
+
+
+@register_layer
+class ELU(Layer):
+    def __init__(self, alpha=1.0, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.alpha = float(alpha)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.where(inputs > 0, inputs,
+                         self.alpha * (jnp.exp(inputs) - 1.0))
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["alpha"] = self.alpha
+        return cfg
+
+
+@register_layer
+class LeakyReLU(Layer):
+    def __init__(self, alpha=0.3, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.alpha = float(alpha)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.where(inputs > 0, inputs, self.alpha * inputs)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["alpha"] = self.alpha
+        return cfg
+
+
+@register_layer
+class ThresholdedReLU(Layer):
+    def __init__(self, theta=1.0, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.theta = float(theta)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.where(inputs > self.theta, inputs, 0.0)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["theta"] = self.theta
+        return cfg
+
+
+@register_layer
+class PReLU(Layer):
+    """Learnable per-channel leak (reference PReLU semantics)."""
+
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+
+    def init_params(self, rng, input_shape):
+        return {"alpha": 0.25 * jnp.ones((input_shape[-1],))}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.where(inputs > 0, inputs, params["alpha"] * inputs)
+
+
+@register_layer
+class SReLU(Layer):
+    """S-shaped ReLU with 4 learnable per-channel params
+    (reference SReLU.scala)."""
+
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+
+    def init_params(self, rng, input_shape):
+        n = input_shape[-1]
+        return {
+            "t_left": jnp.zeros((n,)),
+            "a_left": jnp.zeros((n,)),
+            "t_right": jnp.ones((n,)),
+            "a_right": jnp.ones((n,)),
+        }
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y = jnp.where(inputs < tl, tl + al * (inputs - tl), inputs)
+        return jnp.where(inputs > tr, tr + ar * (inputs - tr), y)

@@ -1,0 +1,796 @@
+"""Convolution layer family (Keras-1 surface, NHWC/NDHWC TPU layouts).
+
+Parity surface: reference zoo/.../pipeline/api/keras/layers/{Convolution1D,
+Convolution2D, Convolution3D, AtrousConvolution1D/2D, SeparableConvolution2D,
+Deconvolution2D, ShareConvolution2D, Cropping*, ZeroPadding*, UpSampling*,
+ResizeBilinear, LocallyConnected1D/2D}.scala.
+
+All convs lower to one ``lax.conv_general_dilated`` with channels-last
+dimension numbers — the layout XLA:TPU tiles directly onto the MXU.
+``dim_ordering="th"`` inputs are accepted for reference parity and transposed
+at the boundary once, not per-op.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....core import initializers
+from .....core import shapes as shape_utils
+from .....core.module import Layer, register_layer
+from .. import activations
+
+_DN = {  # channels-last conv dimension numbers per spatial rank
+    1: ("NWC", "WIO", "NWC"),
+    2: ("NHWC", "HWIO", "NHWC"),
+    3: ("NDHWC", "DHWIO", "NDHWC"),
+}
+
+
+def _padding(border_mode: str, rank: int):
+    if border_mode == "same":
+        return "SAME"
+    if border_mode == "valid":
+        return "VALID"
+    if border_mode == "causal":
+        return None  # handled by explicit pre-pad in Conv1D
+    raise ValueError(f"Unsupported border_mode {border_mode!r}")
+
+
+class _ConvND(Layer):
+    """Shared machinery for 1/2/3-D convolutions."""
+
+    rank: int = 2
+
+    def __init__(self, nb_filter, kernel_size, init="glorot_uniform",
+                 activation=None, border_mode="valid", subsample=1,
+                 dilation=1, dim_ordering=None, bias=True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = shape_utils.normalize_tuple(
+            kernel_size, self.rank, "kernel_size")
+        self.subsample = shape_utils.normalize_tuple(
+            subsample, self.rank, "subsample")
+        self.dilation = shape_utils.normalize_tuple(
+            dilation, self.rank, "dilation")
+        self.border_mode = border_mode
+        self.init_name = init
+        self.activation_name = activation if not callable(activation) else None
+        self.activation = activations.get(activation)
+        self.bias = bias
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+    # -- layout helpers: everything internal is channels-last --
+    def _to_cl(self, x):
+        if self.data_format == "channels_first":
+            perm = (0,) + tuple(range(2, 2 + self.rank)) + (1,)
+            return jnp.transpose(x, perm)
+        return x
+
+    def _from_cl(self, x):
+        if self.data_format == "channels_first":
+            perm = (0, self.rank + 1) + tuple(range(1, self.rank + 1))
+            return jnp.transpose(x, perm)
+        return x
+
+    def _cl_shape(self, input_shape):
+        if self.data_format == "channels_first":
+            return (input_shape[0],) + tuple(input_shape[2:]) + (input_shape[1],)
+        return tuple(input_shape)
+
+    def init_params(self, rng, input_shape):
+        in_ch = self._cl_shape(input_shape)[-1]
+        w_shape = self.kernel_size + (in_ch, self.nb_filter)
+        params = {"W": initializers.get(self.init_name)(rng, w_shape)}
+        if self.bias:
+            params["b"] = jnp.zeros((self.nb_filter,))
+        return params
+
+    def _conv(self, x, w):
+        pad = _padding(self.border_mode, self.rank)
+        if self.border_mode == "causal":  # Conv1D only
+            left = self.dilation[0] * (self.kernel_size[0] - 1)
+            x = jnp.pad(x, ((0, 0), (left, 0), (0, 0)))
+            pad = "VALID"
+        return lax.conv_general_dilated(
+            x, w, window_strides=self.subsample, padding=pad,
+            rhs_dilation=self.dilation,
+            dimension_numbers=_DN[self.rank])
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        x = self._to_cl(inputs)
+        y = self._conv(x, params["W"])
+        if self.bias:
+            y = y + params["b"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return self._from_cl(y)
+
+    def compute_output_shape(self, input_shape):
+        cl = self._cl_shape(input_shape)
+        spatial = [
+            shape_utils.conv_output_length(
+                cl[1 + i], self.kernel_size[i], self.border_mode,
+                self.subsample[i], self.dilation[i])
+            for i in range(self.rank)
+        ]
+        out_cl = (cl[0],) + tuple(spatial) + (self.nb_filter,)
+        if self.data_format == "channels_first":
+            return (out_cl[0], out_cl[-1]) + tuple(out_cl[1:-1])
+        return out_cl
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(nb_filter=self.nb_filter,
+                   kernel_size=list(self.kernel_size), init=self.init_name,
+                   activation=self.activation_name,
+                   border_mode=self.border_mode,
+                   subsample=list(self.subsample),
+                   dilation=list(self.dilation), bias=self.bias,
+                   dim_ordering=self.data_format)
+        return cfg
+
+
+@register_layer
+class Convolution1D(_ConvND):
+    """Reference Convolution1D.scala; input (batch, steps, channels)."""
+
+    rank = 1
+
+    def __init__(self, nb_filter, filter_length=3, kernel_size=None, **kw):
+        super().__init__(nb_filter, kernel_size or filter_length, **kw)
+
+
+@register_layer
+class Convolution2D(_ConvND):
+    """Reference Convolution2D.scala."""
+
+    rank = 2
+
+    def __init__(self, nb_filter, nb_row=3, nb_col=3, kernel_size=None, **kw):
+        super().__init__(nb_filter, kernel_size or (nb_row, nb_col), **kw)
+
+
+@register_layer
+class Convolution3D(_ConvND):
+    """Reference Convolution3D.scala."""
+
+    rank = 3
+
+    def __init__(self, nb_filter, kernel_dim1=3, kernel_dim2=3, kernel_dim3=3,
+                 kernel_size=None, **kw):
+        super().__init__(
+            nb_filter, kernel_size or (kernel_dim1, kernel_dim2, kernel_dim3),
+            **kw)
+
+
+@register_layer
+class AtrousConvolution1D(Convolution1D):
+    """Dilated 1D conv (reference AtrousConvolution1D.scala)."""
+
+    def __init__(self, nb_filter, filter_length=3, atrous_rate=1, **kw):
+        kw.setdefault("dilation", atrous_rate)
+        super().__init__(nb_filter, filter_length, **kw)
+
+
+@register_layer
+class AtrousConvolution2D(Convolution2D):
+    """Dilated 2D conv (reference AtrousConvolution2D.scala)."""
+
+    def __init__(self, nb_filter, nb_row=3, nb_col=3, atrous_rate=(1, 1),
+                 **kw):
+        kw.setdefault("dilation", atrous_rate)
+        super().__init__(nb_filter, nb_row, nb_col, **kw)
+
+
+@register_layer
+class ShareConvolution2D(Convolution2D):
+    """Weight-shared conv (reference ShareConvolution2D.scala).
+
+    Weight sharing in this framework is "call the same layer instance twice"
+    — the graph engine maps one params entry per instance — so this is
+    behaviourally Convolution2D.
+    """
+
+
+@register_layer
+class SeparableConvolution2D(Layer):
+    """Depthwise-separable conv (reference SeparableConvolution2D.scala)."""
+
+    def __init__(self, nb_filter, nb_row=3, nb_col=3, init="glorot_uniform",
+                 activation=None, border_mode="valid", subsample=(1, 1),
+                 depth_multiplier=1, dim_ordering=None, bias=True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.subsample = shape_utils.normalize_tuple(subsample, 2)
+        self.border_mode = border_mode
+        self.depth_multiplier = int(depth_multiplier)
+        self.init_name = init
+        self.activation_name = activation if not callable(activation) else None
+        self.activation = activations.get(activation)
+        self.bias = bias
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+    def _cl_shape(self, s):
+        if self.data_format == "channels_first":
+            return (s[0], s[2], s[3], s[1])
+        return tuple(s)
+
+    def init_params(self, rng, input_shape):
+        in_ch = self._cl_shape(input_shape)[-1]
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "depthwise": initializers.get(self.init_name)(
+                k1, self.kernel_size + (1, in_ch * self.depth_multiplier)),
+            "pointwise": initializers.get(self.init_name)(
+                k2, (1, 1, in_ch * self.depth_multiplier, self.nb_filter)),
+        }
+        if self.bias:
+            params["b"] = jnp.zeros((self.nb_filter,))
+        return params
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        x = inputs
+        if self.data_format == "channels_first":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        in_ch = x.shape[-1]
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        y = lax.conv_general_dilated(
+            x, params["depthwise"], window_strides=self.subsample,
+            padding=pad, dimension_numbers=_DN[2],
+            feature_group_count=in_ch)
+        y = lax.conv_general_dilated(
+            y, params["pointwise"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=_DN[2])
+        if self.bias:
+            y = y + params["b"]
+        if self.activation is not None:
+            y = self.activation(y)
+        if self.data_format == "channels_first":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        cl = self._cl_shape(input_shape)
+        spatial = [
+            shape_utils.conv_output_length(
+                cl[1 + i], self.kernel_size[i], self.border_mode,
+                self.subsample[i]) for i in range(2)]
+        out = (cl[0],) + tuple(spatial) + (self.nb_filter,)
+        if self.data_format == "channels_first":
+            return (out[0], out[3], out[1], out[2])
+        return out
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(nb_filter=self.nb_filter, nb_row=self.kernel_size[0],
+                   nb_col=self.kernel_size[1], init=self.init_name,
+                   activation=self.activation_name,
+                   border_mode=self.border_mode,
+                   subsample=list(self.subsample),
+                   depth_multiplier=self.depth_multiplier, bias=self.bias,
+                   dim_ordering=self.data_format)
+        return cfg
+
+
+@register_layer
+class Deconvolution2D(Layer):
+    """Transposed 2D conv (reference Deconvolution2D.scala)."""
+
+    def __init__(self, nb_filter, nb_row=3, nb_col=3, init="glorot_uniform",
+                 activation=None, border_mode="valid", subsample=(1, 1),
+                 dim_ordering=None, bias=True, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.subsample = shape_utils.normalize_tuple(subsample, 2)
+        self.border_mode = border_mode
+        self.init_name = init
+        self.activation_name = activation if not callable(activation) else None
+        self.activation = activations.get(activation)
+        self.bias = bias
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+    def _cl_shape(self, s):
+        if self.data_format == "channels_first":
+            return (s[0], s[2], s[3], s[1])
+        return tuple(s)
+
+    def init_params(self, rng, input_shape):
+        in_ch = self._cl_shape(input_shape)[-1]
+        params = {"W": initializers.get(self.init_name)(
+            rng, self.kernel_size + (in_ch, self.nb_filter))}
+        if self.bias:
+            params["b"] = jnp.zeros((self.nb_filter,))
+        return params
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        x = inputs
+        if self.data_format == "channels_first":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        y = lax.conv_transpose(
+            x, params["W"], strides=self.subsample, padding=pad,
+            dimension_numbers=_DN[2])
+        if self.bias:
+            y = y + params["b"]
+        if self.activation is not None:
+            y = self.activation(y)
+        if self.data_format == "channels_first":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        cl = self._cl_shape(input_shape)
+        spatial = [
+            shape_utils.deconv_output_length(
+                cl[1 + i], self.kernel_size[i], self.border_mode,
+                self.subsample[i]) for i in range(2)]
+        out = (cl[0],) + tuple(spatial) + (self.nb_filter,)
+        if self.data_format == "channels_first":
+            return (out[0], out[3], out[1], out[2])
+        return out
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(nb_filter=self.nb_filter, nb_row=self.kernel_size[0],
+                   nb_col=self.kernel_size[1], init=self.init_name,
+                   activation=self.activation_name,
+                   border_mode=self.border_mode,
+                   subsample=list(self.subsample), bias=self.bias,
+                   dim_ordering=self.data_format)
+        return cfg
+
+
+@register_layer
+class LocallyConnected1D(Layer):
+    """Conv1D with unshared weights (reference LocallyConnected1D.scala)."""
+
+    def __init__(self, nb_filter, filter_length=3, activation=None,
+                 border_mode="valid", subsample_length=1, bias=True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.filter_length = int(filter_length)
+        self.subsample = int(subsample_length)
+        self.border_mode = border_mode
+        self.activation_name = activation if not callable(activation) else None
+        self.activation = activations.get(activation)
+        self.bias = bias
+
+    def _out_steps(self, steps):
+        return shape_utils.conv_output_length(
+            steps, self.filter_length, self.border_mode, self.subsample)
+
+    def init_params(self, rng, input_shape):
+        steps, ch = input_shape[1], input_shape[2]
+        out_steps = self._out_steps(steps)
+        params = {"W": initializers.glorot_uniform(
+            rng, (out_steps, self.filter_length * ch, self.nb_filter))}
+        if self.bias:
+            params["b"] = jnp.zeros((out_steps, self.nb_filter))
+        return params
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        # extract patches: (b, out_steps, filter_length*ch)
+        out_steps = params["W"].shape[0]
+        idx = (jnp.arange(out_steps)[:, None] * self.subsample
+               + jnp.arange(self.filter_length)[None, :])
+        patches = inputs[:, idx, :]  # (b, out_steps, fl, ch)
+        patches = patches.reshape(inputs.shape[0], out_steps, -1)
+        y = jnp.einsum("bsk,sko->bso", patches, params["W"])
+        if self.bias:
+            y = y + params["b"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self._out_steps(input_shape[1]),
+                self.nb_filter)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(nb_filter=self.nb_filter, filter_length=self.filter_length,
+                   activation=self.activation_name,
+                   border_mode=self.border_mode,
+                   subsample_length=self.subsample, bias=self.bias)
+        return cfg
+
+
+@register_layer
+class LocallyConnected2D(Layer):
+    """Conv2D with unshared weights (reference LocallyConnected2D.scala)."""
+
+    def __init__(self, nb_filter, nb_row=3, nb_col=3, activation=None,
+                 border_mode="valid", subsample=(1, 1), dim_ordering=None,
+                 bias=True, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.subsample = shape_utils.normalize_tuple(subsample, 2)
+        self.border_mode = border_mode
+        self.activation_name = activation if not callable(activation) else None
+        self.activation = activations.get(activation)
+        self.bias = bias
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+    def _cl_shape(self, s):
+        if self.data_format == "channels_first":
+            return (s[0], s[2], s[3], s[1])
+        return tuple(s)
+
+    def _out_spatial(self, cl):
+        return tuple(
+            shape_utils.conv_output_length(
+                cl[1 + i], self.kernel_size[i], self.border_mode,
+                self.subsample[i]) for i in range(2))
+
+    def init_params(self, rng, input_shape):
+        cl = self._cl_shape(input_shape)
+        oh, ow = self._out_spatial(cl)
+        k = self.kernel_size[0] * self.kernel_size[1] * cl[-1]
+        params = {"W": initializers.glorot_uniform(
+            rng, (oh * ow, k, self.nb_filter))}
+        if self.bias:
+            params["b"] = jnp.zeros((oh * ow, self.nb_filter))
+        return params
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        x = inputs
+        if self.data_format == "channels_first":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        b, h, w, c = x.shape
+        oh, ow = self._out_spatial((b, h, w, c))
+        kh, kw = self.kernel_size
+        sh, sw = self.subsample
+        ri = jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :]
+        ci = jnp.arange(ow)[:, None] * sw + jnp.arange(kw)[None, :]
+        patches = x[:, ri[:, None, :, None], ci[None, :, None, :], :]
+        patches = patches.reshape(b, oh * ow, kh * kw * c)
+        y = jnp.einsum("bsk,sko->bso", patches, params["W"])
+        if self.bias:
+            y = y + params["b"]
+        y = y.reshape(b, oh, ow, self.nb_filter)
+        if self.activation is not None:
+            y = self.activation(y)
+        if self.data_format == "channels_first":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def compute_output_shape(self, input_shape):
+        cl = self._cl_shape(input_shape)
+        oh, ow = self._out_spatial(cl)
+        out = (cl[0], oh, ow, self.nb_filter)
+        if self.data_format == "channels_first":
+            return (out[0], out[3], out[1], out[2])
+        return out
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(nb_filter=self.nb_filter, nb_row=self.kernel_size[0],
+                   nb_col=self.kernel_size[1],
+                   activation=self.activation_name,
+                   border_mode=self.border_mode,
+                   subsample=list(self.subsample), bias=self.bias,
+                   dim_ordering=self.data_format)
+        return cfg
+
+
+class _PadCropBase(Layer):
+    def __init__(self, dim_ordering=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+
+@register_layer
+class ZeroPadding1D(Layer):
+    """Reference ZeroPadding1D.scala."""
+
+    def __init__(self, padding=1, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.padding = shape_utils.normalize_tuple(padding, 2) \
+            if not isinstance(padding, int) else (padding, padding)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.pad(inputs, ((0, 0), self.padding, (0, 0)))
+
+    def compute_output_shape(self, input_shape):
+        steps = input_shape[1]
+        steps = None if steps is None else steps + sum(self.padding)
+        return (input_shape[0], steps, input_shape[2])
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["padding"] = list(self.padding)
+        return cfg
+
+
+@register_layer
+class ZeroPadding2D(_PadCropBase):
+    """Reference ZeroPadding2D.scala."""
+
+    def __init__(self, padding=(1, 1), dim_ordering=None, input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering=dim_ordering, input_shape=input_shape,
+                         name=name)
+        if len(padding) == 2:
+            self.padding = ((padding[0], padding[0]),
+                            (padding[1], padding[1]))
+        else:
+            self.padding = ((padding[0], padding[1]),
+                            (padding[2], padding[3]))
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if self.data_format == "channels_last":
+            pads = ((0, 0),) + self.padding + ((0, 0),)
+        else:
+            pads = ((0, 0), (0, 0)) + self.padding
+        return jnp.pad(inputs, pads)
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        axes = (1, 2) if self.data_format == "channels_last" else (2, 3)
+        for ax, (lo, hi) in zip(axes, self.padding):
+            if s[ax] is not None:
+                s[ax] += lo + hi
+        return tuple(s)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["padding"] = [p for pair in self.padding for p in pair]
+        cfg["dim_ordering"] = self.data_format
+        return cfg
+
+
+@register_layer
+class ZeroPadding3D(_PadCropBase):
+    """Reference ZeroPadding3D.scala."""
+
+    def __init__(self, padding=(1, 1, 1), dim_ordering=None, input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering=dim_ordering, input_shape=input_shape,
+                         name=name)
+        self.padding = tuple(int(p) for p in padding)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        p = [(x, x) for x in self.padding]
+        if self.data_format == "channels_last":
+            pads = [(0, 0)] + p + [(0, 0)]
+        else:
+            pads = [(0, 0), (0, 0)] + p
+        return jnp.pad(inputs, pads)
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        axes = (1, 2, 3) if self.data_format == "channels_last" else (2, 3, 4)
+        for ax, p in zip(axes, self.padding):
+            if s[ax] is not None:
+                s[ax] += 2 * p
+        return tuple(s)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["padding"] = list(self.padding)
+        cfg["dim_ordering"] = self.data_format
+        return cfg
+
+
+@register_layer
+class Cropping1D(Layer):
+    """Reference Cropping1D.scala."""
+
+    def __init__(self, cropping=(1, 1), input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.cropping = tuple(int(c) for c in cropping)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        lo, hi = self.cropping
+        return inputs[:, lo:inputs.shape[1] - hi, :]
+
+    def compute_output_shape(self, input_shape):
+        steps = input_shape[1]
+        if steps is not None:
+            steps -= self.cropping[0] + self.cropping[1]
+        return (input_shape[0], steps, input_shape[2])
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["cropping"] = list(self.cropping)
+        return cfg
+
+
+@register_layer
+class Cropping2D(_PadCropBase):
+    """Reference Cropping2D.scala."""
+
+    def __init__(self, cropping=((0, 0), (0, 0)), dim_ordering=None,
+                 input_shape=None, name=None):
+        super().__init__(dim_ordering=dim_ordering, input_shape=input_shape,
+                         name=name)
+        self.cropping = tuple(tuple(int(x) for x in c) for c in cropping)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        (t, b), (l, r) = self.cropping
+        if self.data_format == "channels_last":
+            return inputs[:, t:inputs.shape[1] - b, l:inputs.shape[2] - r, :]
+        return inputs[:, :, t:inputs.shape[2] - b, l:inputs.shape[3] - r]
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        axes = (1, 2) if self.data_format == "channels_last" else (2, 3)
+        for ax, (lo, hi) in zip(axes, self.cropping):
+            if s[ax] is not None:
+                s[ax] -= lo + hi
+        return tuple(s)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["cropping"] = [list(c) for c in self.cropping]
+        cfg["dim_ordering"] = self.data_format
+        return cfg
+
+
+@register_layer
+class Cropping3D(_PadCropBase):
+    """Reference Cropping3D.scala."""
+
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), dim_ordering=None,
+                 input_shape=None, name=None):
+        super().__init__(dim_ordering=dim_ordering, input_shape=input_shape,
+                         name=name)
+        self.cropping = tuple(tuple(int(x) for x in c) for c in cropping)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        (a0, b0), (a1, b1), (a2, b2) = self.cropping
+        if self.data_format == "channels_last":
+            return inputs[:, a0:inputs.shape[1] - b0,
+                          a1:inputs.shape[2] - b1,
+                          a2:inputs.shape[3] - b2, :]
+        return inputs[:, :, a0:inputs.shape[2] - b0,
+                      a1:inputs.shape[3] - b1, a2:inputs.shape[4] - b2]
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        axes = (1, 2, 3) if self.data_format == "channels_last" else (2, 3, 4)
+        for ax, (lo, hi) in zip(axes, self.cropping):
+            if s[ax] is not None:
+                s[ax] -= lo + hi
+        return tuple(s)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["cropping"] = [list(c) for c in self.cropping]
+        cfg["dim_ordering"] = self.data_format
+        return cfg
+
+
+@register_layer
+class UpSampling1D(Layer):
+    """Reference UpSampling1D.scala."""
+
+    def __init__(self, length=2, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.length = int(length)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.repeat(inputs, self.length, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        steps = input_shape[1]
+        return (input_shape[0],
+                None if steps is None else steps * self.length,
+                input_shape[2])
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["length"] = self.length
+        return cfg
+
+
+@register_layer
+class UpSampling2D(_PadCropBase):
+    """Reference UpSampling2D.scala."""
+
+    def __init__(self, size=(2, 2), dim_ordering=None, input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering=dim_ordering, input_shape=input_shape,
+                         name=name)
+        self.size = shape_utils.normalize_tuple(size, 2)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        axes = (1, 2) if self.data_format == "channels_last" else (2, 3)
+        y = jnp.repeat(inputs, self.size[0], axis=axes[0])
+        return jnp.repeat(y, self.size[1], axis=axes[1])
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        axes = (1, 2) if self.data_format == "channels_last" else (2, 3)
+        for ax, k in zip(axes, self.size):
+            if s[ax] is not None:
+                s[ax] *= k
+        return tuple(s)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["size"] = list(self.size)
+        cfg["dim_ordering"] = self.data_format
+        return cfg
+
+
+@register_layer
+class UpSampling3D(_PadCropBase):
+    """Reference UpSampling3D.scala."""
+
+    def __init__(self, size=(2, 2, 2), dim_ordering=None, input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering=dim_ordering, input_shape=input_shape,
+                         name=name)
+        self.size = shape_utils.normalize_tuple(size, 3)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        axes = (1, 2, 3) if self.data_format == "channels_last" else (2, 3, 4)
+        y = inputs
+        for ax, k in zip(axes, self.size):
+            y = jnp.repeat(y, k, axis=ax)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        s = list(input_shape)
+        axes = (1, 2, 3) if self.data_format == "channels_last" else (2, 3, 4)
+        for ax, k in zip(axes, self.size):
+            if s[ax] is not None:
+                s[ax] *= k
+        return tuple(s)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["size"] = list(self.size)
+        cfg["dim_ordering"] = self.data_format
+        return cfg
+
+
+@register_layer
+class ResizeBilinear(_PadCropBase):
+    """Bilinear resize (reference ResizeBilinear.scala) via jax.image."""
+
+    def __init__(self, output_height=None, output_width=None,
+                 align_corners=False, dim_ordering=None, input_shape=None,
+                 name=None):
+        super().__init__(dim_ordering=dim_ordering, input_shape=input_shape,
+                         name=name)
+        self.output_height = int(output_height)
+        self.output_width = int(output_width)
+        self.align_corners = align_corners
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if self.data_format == "channels_last":
+            shape = (inputs.shape[0], self.output_height, self.output_width,
+                     inputs.shape[3])
+        else:
+            shape = (inputs.shape[0], inputs.shape[1], self.output_height,
+                     self.output_width)
+        return jax.image.resize(inputs, shape, method="bilinear")
+
+    def compute_output_shape(self, input_shape):
+        if self.data_format == "channels_last":
+            return (input_shape[0], self.output_height, self.output_width,
+                    input_shape[3])
+        return (input_shape[0], input_shape[1], self.output_height,
+                self.output_width)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(output_height=self.output_height,
+                   output_width=self.output_width,
+                   align_corners=self.align_corners,
+                   dim_ordering=self.data_format)
+        return cfg

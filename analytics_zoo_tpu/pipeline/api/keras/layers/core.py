@@ -1,0 +1,397 @@
+"""Core Keras-1 layers: Dense, Activation, Dropout, Flatten, reshape family.
+
+Parity surface: reference zoo/.../pipeline/api/keras/layers/{Dense, Activation,
+Dropout, Flatten, Reshape, Permute, RepeatVector, Highway, MaxoutDense,
+Masking, SparseDense}.scala.  Implementations are direct jnp — Dense is a
+single MXU matmul; dropout uses explicit rng threading so training steps stay
+pure and reproducible under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .....core import initializers
+from .....core import shapes as shape_utils
+from .....core.module import Layer, register_layer
+from .. import activations
+
+
+@register_layer
+class Dense(Layer):
+    """Fully connected layer: ``y = act(x @ W + b)``.
+
+    Reference: zoo/.../keras/layers/Dense.scala.  Weight layout is
+    (in, out) — row-major matmul feeding the MXU directly.
+    """
+
+    def __init__(self, output_dim, init="glorot_uniform", activation=None,
+                 W_regularizer=None, b_regularizer=None, bias=True,
+                 input_dim=None, input_shape=None, name=None):
+        if input_dim is not None and input_shape is None:
+            input_shape = (input_dim,)
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = int(output_dim)
+        self.init_name = init
+        self.activation_name = activation if not callable(activation) else None
+        self.activation = activations.get(activation)
+        self.bias = bias
+        self.W_regularizer = W_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init_params(self, rng, input_shape):
+        in_dim = input_shape[-1]
+        k_rng, _ = jax.random.split(rng)
+        params = {"W": initializers.get(self.init_name)(
+            k_rng, (in_dim, self.output_dim))}
+        if self.bias:
+            params["b"] = jnp.zeros((self.output_dim,))
+        return params
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        y = inputs @ params["W"]
+        if self.bias:
+            y = y + params["b"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(output_dim=self.output_dim, init=self.init_name,
+                   activation=self.activation_name, bias=self.bias)
+        return cfg
+
+
+@register_layer
+class SparseDense(Dense):
+    """Dense accepting sparse-style (indices bags) or dense input.
+
+    Reference: zoo/.../keras/layers/SparseDense.scala.  On TPU a "sparse
+    tensor" is represented densely (XLA has no sparse layouts); the API is
+    kept for parity and simply densifies.
+    """
+
+
+@register_layer
+class Activation(Layer):
+    def __init__(self, activation=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.activation_name = activation
+        self.activation = activations.get(activation)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return self.activation(inputs)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["activation"] = self.activation_name
+        return cfg
+
+
+@register_layer
+class Dropout(Layer):
+    """Inverted dropout; identity at inference (reference Dropout.scala)."""
+
+    stochastic = True
+
+    def __init__(self, p=0.5, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = float(p)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return inputs
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, inputs.shape)
+        return jnp.where(mask, inputs / keep, 0.0)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["p"] = self.p
+        return cfg
+
+
+@register_layer
+class SpatialDropout1D(Dropout):
+    """Drop entire feature channels across timesteps (reference SpatialDropout1D.scala)."""
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return inputs
+        keep = 1.0 - self.p
+        b, _, c = inputs.shape
+        mask = jax.random.bernoulli(rng, keep, (b, 1, c))
+        return jnp.where(mask, inputs / keep, 0.0)
+
+
+@register_layer
+class SpatialDropout2D(Dropout):
+    """Drop entire channels of a 4D tensor (reference SpatialDropout2D.scala)."""
+
+    def __init__(self, p=0.5, dim_ordering=None, input_shape=None, name=None):
+        super().__init__(p=p, input_shape=input_shape, name=name)
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return inputs
+        keep = 1.0 - self.p
+        b = inputs.shape[0]
+        if self.data_format == "channels_last":
+            mask_shape = (b, 1, 1, inputs.shape[3])
+        else:
+            mask_shape = (b, inputs.shape[1], 1, 1)
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
+        return jnp.where(mask, inputs / keep, 0.0)
+
+
+@register_layer
+class SpatialDropout3D(Dropout):
+    def __init__(self, p=0.5, dim_ordering=None, input_shape=None, name=None):
+        super().__init__(p=p, input_shape=input_shape, name=name)
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if not training or self.p <= 0.0 or rng is None:
+            return inputs
+        keep = 1.0 - self.p
+        b = inputs.shape[0]
+        if self.data_format == "channels_last":
+            mask_shape = (b, 1, 1, 1, inputs.shape[4])
+        else:
+            mask_shape = (b, inputs.shape[1], 1, 1, 1)
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
+        return jnp.where(mask, inputs / keep, 0.0)
+
+
+@register_layer
+class Flatten(Layer):
+    """Flatten all non-batch dims (reference Flatten.scala)."""
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return inputs.reshape(inputs.shape[0], -1)
+
+    def compute_output_shape(self, input_shape):
+        dims = input_shape[1:]
+        if any(d is None for d in dims):
+            return (input_shape[0], None)
+        return (input_shape[0], int(np.prod(dims)))
+
+
+@register_layer
+class Reshape(Layer):
+    """Reshape non-batch dims; one dim may be -1 (reference Reshape.scala)."""
+
+    def __init__(self, target_shape=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.target_shape = tuple(int(d) for d in target_shape)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return inputs.reshape((inputs.shape[0],) + self.target_shape)
+
+    def compute_output_shape(self, input_shape):
+        dims = input_shape[1:]
+        tgt = list(self.target_shape)
+        if -1 in tgt:
+            known = int(np.prod([d for d in tgt if d != -1]))
+            total = int(np.prod(dims)) if all(d is not None for d in dims) else None
+            tgt[tgt.index(-1)] = total // known if total else None
+        return (input_shape[0],) + tuple(tgt)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["target_shape"] = list(self.target_shape)
+        return cfg
+
+
+@register_layer
+class Permute(Layer):
+    """Permute non-batch dims; dims are 1-indexed as in Keras-1 (reference Permute.scala)."""
+
+    def __init__(self, dims=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dims = tuple(int(d) for d in dims)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.transpose(inputs, (0,) + self.dims)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + tuple(input_shape[d] for d in self.dims)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["dims"] = list(self.dims)
+        return cfg
+
+
+@register_layer
+class RepeatVector(Layer):
+    """(batch, features) -> (batch, n, features) (reference RepeatVector.scala)."""
+
+    def __init__(self, n=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.n = int(n)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.repeat(inputs[:, None, :], self.n, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.n, input_shape[1])
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["n"] = self.n
+        return cfg
+
+
+@register_layer
+class Masking(Layer):
+    """Zero out timesteps equal to mask_value (reference Masking.scala).
+
+    Under jit, masks are dense multiplicative tensors, not ragged metadata.
+    """
+
+    def __init__(self, mask_value=0.0, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.mask_value = float(mask_value)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        keep = jnp.any(inputs != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, inputs, 0.0)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["mask_value"] = self.mask_value
+        return cfg
+
+
+@register_layer
+class Highway(Layer):
+    """Highway network layer (reference Highway.scala): y = t*h + (1-t)*x."""
+
+    def __init__(self, activation="tanh", bias=True, input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.activation_name = activation
+        self.activation = activations.get(activation or "linear")
+        self.bias = bias
+
+    def init_params(self, rng, input_shape):
+        d = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "W_h": initializers.glorot_uniform(k1, (d, d)),
+            "W_t": initializers.glorot_uniform(k2, (d, d)),
+        }
+        if self.bias:
+            params["b_h"] = jnp.zeros((d,))
+            # negative transform-gate bias biases toward carry at init
+            params["b_t"] = -2.0 * jnp.ones((d,))
+        return params
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        h = inputs @ params["W_h"]
+        t = inputs @ params["W_t"]
+        if self.bias:
+            h = h + params["b_h"]
+            t = t + params["b_t"]
+        h = self.activation(h)
+        t = jax.nn.sigmoid(t)
+        return t * h + (1.0 - t) * inputs
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(activation=self.activation_name, bias=self.bias)
+        return cfg
+
+
+@register_layer
+class MaxoutDense(Layer):
+    """Maxout over nb_feature linear maps (reference MaxoutDense.scala)."""
+
+    def __init__(self, output_dim, nb_feature=4, bias=True, input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = int(output_dim)
+        self.nb_feature = int(nb_feature)
+        self.bias = bias
+
+    def init_params(self, rng, input_shape):
+        d = input_shape[-1]
+        params = {"W": initializers.glorot_uniform(
+            rng, (self.nb_feature, d, self.output_dim))}
+        if self.bias:
+            params["b"] = jnp.zeros((self.nb_feature, self.output_dim))
+        return params
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        y = jnp.einsum("bd,kdo->bko", inputs, params["W"])
+        if self.bias:
+            y = y + params["b"]
+        return jnp.max(y, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.output_dim)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(output_dim=self.output_dim, nb_feature=self.nb_feature,
+                   bias=self.bias)
+        return cfg
+
+
+@register_layer
+class TimeDistributed(Layer):
+    """Apply an inner layer to every timestep (reference TimeDistributed.scala).
+
+    Implemented by folding time into batch — one big MXU-friendly op instead
+    of a per-step loop.
+    """
+
+    stateful = True
+    stochastic = True
+
+    def __init__(self, layer=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.layer = layer
+
+    def init(self, rng, input_shape):
+        inner_shape = (input_shape[0],) + tuple(input_shape[2:])
+        return self.layer.init(rng, inner_shape)
+
+    def apply(self, params, state, inputs, training=False, rng=None):
+        b, t = inputs.shape[0], inputs.shape[1]
+        flat = inputs.reshape((b * t,) + inputs.shape[2:])
+        out, new_state = self.layer.apply(params, state, flat,
+                                          training=training, rng=rng)
+        return out.reshape((b, t) + out.shape[1:]), new_state
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return self.apply(params, state, inputs, training=training, rng=rng)[0]
+
+    def compute_output_shape(self, input_shape):
+        inner_in = (input_shape[0],) + tuple(input_shape[2:])
+        inner_out = self.layer.compute_output_shape(inner_in)
+        return (input_shape[0], input_shape[1]) + tuple(inner_out[1:])
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["layer"] = {"class_name": type(self.layer).__name__,
+                        "config": self.layer.get_config()}
+        return cfg
+
+    @classmethod
+    def from_config(cls, config):
+        from .....core.module import get_layer_class
+        inner = config.pop("layer")
+        layer = get_layer_class(inner["class_name"]).from_config(
+            inner["config"])
+        return cls(layer=layer, **config)

@@ -1,0 +1,96 @@
+"""Merge layer and functional merge helpers.
+
+Parity surface: reference zoo/.../pipeline/api/keras/layers/Merge.scala with
+modes sum/mul/max/min/ave/sub/div/concat/dot/cosine, plus the keras2-style
+Maximum/Minimum/Average wrappers (zoo/.../pipeline/api/keras2/layers).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....core.graph import broadcast_shapes
+from .....core.module import Layer, register_layer
+
+
+@register_layer
+class Merge(Layer):
+    """Merge a list of inputs into one tensor (reference Merge.scala)."""
+
+    def __init__(self, layers=None, mode="sum", concat_axis=-1,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.mode = mode
+        self.concat_axis = int(concat_axis)
+        self.layers = layers  # Sequential-embedded branch layers (optional)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        xs = list(inputs)
+        m = self.mode
+        if m == "sum":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+        if m == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if m == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if m == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
+            return out
+        if m == "ave":
+            return sum(xs) / float(len(xs))
+        if m == "sub":
+            return xs[0] - xs[1]
+        if m == "div":
+            return xs[0] / xs[1]
+        if m == "concat":
+            return jnp.concatenate(xs, axis=self.concat_axis)
+        if m == "dot":
+            return jnp.sum(xs[0] * xs[1], axis=-1, keepdims=True)
+        if m == "cosine":
+            a = xs[0] / jnp.maximum(
+                jnp.linalg.norm(xs[0], axis=-1, keepdims=True), 1e-12)
+            b = xs[1] / jnp.maximum(
+                jnp.linalg.norm(xs[1], axis=-1, keepdims=True), 1e-12)
+            return jnp.sum(a * b, axis=-1, keepdims=True)
+        raise ValueError(f"Unknown merge mode {self.mode!r}")
+
+    def compute_output_shape(self, input_shape):
+        shapes = [tuple(s) for s in input_shape]
+        if self.mode == "concat":
+            s = list(shapes[0])
+            ax = self.concat_axis % len(s)
+            total = 0
+            for sh in shapes:
+                if sh[ax] is None:
+                    total = None
+                    break
+                total += sh[ax]
+            s[ax] = total
+            return tuple(s)
+        if self.mode in ("dot", "cosine"):
+            return (shapes[0][0], 1)
+        out = shapes[0]
+        for s in shapes[1:]:
+            out = broadcast_shapes(out, s)
+        return out
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(mode=self.mode, concat_axis=self.concat_axis)
+        return cfg
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    """Functional merge over Variables (reference keras merge helper)."""
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(list(inputs))

@@ -1,0 +1,53 @@
+"""Noise regularization layers.
+
+Parity surface: reference zoo/.../pipeline/api/keras/layers/{GaussianNoise,
+GaussianDropout}.scala.  Both are identity at inference; noise threads through
+the explicit layer rng so runs are reproducible under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.module import Layer, register_layer
+
+
+@register_layer
+class GaussianNoise(Layer):
+    stochastic = True
+
+    def __init__(self, sigma=0.1, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.sigma = float(sigma)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if not training or rng is None:
+            return inputs
+        return inputs + self.sigma * jax.random.normal(rng, inputs.shape)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["sigma"] = self.sigma
+        return cfg
+
+
+@register_layer
+class GaussianDropout(Layer):
+    stochastic = True
+
+    def __init__(self, p=0.5, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = float(p)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if not training or rng is None or self.p <= 0:
+            return inputs
+        stddev = (self.p / (1.0 - self.p)) ** 0.5
+        return inputs * (
+            1.0 + stddev * jax.random.normal(rng, inputs.shape))
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["p"] = self.p
+        return cfg

@@ -1,0 +1,167 @@
+"""Normalization layers.
+
+Parity surface: reference zoo/.../pipeline/api/keras/layers/
+{BatchNormalization, WithinChannelLRN2D}.scala.  BatchNorm carries its moving
+stats in the layer *state* collection (non-trainable pytree), updated
+functionally — the jit-safe analogue of BigDL's mutable runningMean/runningVar
+buffers.  Cross-replica statistics: when training data-parallel under jit with
+a sharded batch axis, XLA computes global batch statistics automatically
+because ``jnp.mean`` over a sharded axis lowers to a psum over ICI.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....core import shapes as shape_utils
+from .....core.module import Layer, register_layer
+
+
+@register_layer
+class BatchNormalization(Layer):
+    stateful = True
+
+    def __init__(self, epsilon=1e-3, momentum=0.99, beta_init="zero",
+                 gamma_init="one", dim_ordering=None, input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.epsilon = float(epsilon)
+        self.momentum = float(momentum)
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+    def _channel_axis(self, ndim):
+        return 1 if self.data_format == "channels_first" and ndim > 2 else -1
+
+    def _num_features(self, input_shape):
+        return input_shape[self._channel_axis(len(input_shape))]
+
+    def init_params(self, rng, input_shape):
+        n = self._num_features(input_shape)
+        return {"gamma": jnp.ones((n,)), "beta": jnp.zeros((n,))}
+
+    def init_state(self, input_shape):
+        n = self._num_features(input_shape)
+        return {"moving_mean": jnp.zeros((n,)),
+                "moving_var": jnp.ones((n,))}
+
+    def apply(self, params, state, inputs, training=False, rng=None):
+        ndim = inputs.ndim
+        ch_axis = self._channel_axis(ndim) % ndim
+        reduce_axes = tuple(i for i in range(ndim) if i != ch_axis)
+        bshape = [1] * ndim
+        bshape[ch_axis] = inputs.shape[ch_axis]
+
+        if training:
+            mean = jnp.mean(inputs, axis=reduce_axes)
+            var = jnp.var(inputs, axis=reduce_axes)
+            m = self.momentum
+            new_state = {
+                "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
+                "moving_var": m * state["moving_var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = state["moving_mean"], state["moving_var"]
+            new_state = state
+
+        inv = params["gamma"].reshape(bshape) * (
+            1.0 / jnp.sqrt(var.reshape(bshape) + self.epsilon))
+        out = (inputs - mean.reshape(bshape)) * inv \
+            + params["beta"].reshape(bshape)
+        return out, new_state
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return self.apply(params, state, inputs, training=training,
+                          rng=rng)[0]
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(epsilon=self.epsilon, momentum=self.momentum,
+                   dim_ordering=self.data_format)
+        return cfg
+
+
+@register_layer
+class WithinChannelLRN2D(Layer):
+    """Local response normalization within channels (reference WithinChannelLRN2D.scala)."""
+
+    def __init__(self, size=5, alpha=1.0, beta=0.75, input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = int(size)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        from jax import lax
+        # average squares over a size×size spatial window, per channel (NHWC)
+        sq = jnp.square(inputs)
+        window = (1, self.size, self.size, 1)
+        summed = lax.reduce_window(sq, 0.0, lax.add, window, (1, 1, 1, 1),
+                                   "SAME")
+        counts = lax.reduce_window(jnp.ones_like(sq), 0.0, lax.add, window,
+                                   (1, 1, 1, 1), "SAME")
+        scale = (1.0 + self.alpha * summed / counts) ** self.beta
+        return inputs / scale
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(size=self.size, alpha=self.alpha, beta=self.beta)
+        return cfg
+
+
+@register_layer
+class LRN2D(Layer):
+    """Cross-channel local response normalization (AlexNet-style)."""
+
+    def __init__(self, alpha=1e-4, k=1.0, beta=0.75, n=5, dim_ordering=None,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.alpha, self.k, self.beta, self.n = (
+            float(alpha), float(k), float(beta), int(n))
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        x = inputs
+        if self.data_format == "channels_first":
+            x = jnp.moveaxis(x, 1, -1)
+        sq = jnp.square(x)
+        half = self.n // 2
+        pads = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+        padded = jnp.pad(sq, pads)
+        acc = sum(
+            padded[..., i:i + x.shape[-1]] for i in range(self.n))
+        y = x / (self.k + self.alpha / self.n * acc) ** self.beta
+        if self.data_format == "channels_first":
+            y = jnp.moveaxis(y, -1, 1)
+        return y
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(alpha=self.alpha, k=self.k, beta=self.beta, n=self.n,
+                   dim_ordering=self.data_format)
+        return cfg
+
+
+@register_layer
+class LayerNorm(Layer):
+    """Layer normalization over the feature axis (TPU-era extension;
+    required by the attention/transformer stack in ops/attention.py)."""
+
+    def __init__(self, epsilon=1e-5, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.epsilon = float(epsilon)
+
+    def init_params(self, rng, input_shape):
+        n = input_shape[-1]
+        return {"gamma": jnp.ones((n,)), "beta": jnp.zeros((n,))}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        mean = jnp.mean(inputs, axis=-1, keepdims=True)
+        var = jnp.var(inputs, axis=-1, keepdims=True)
+        y = (inputs - mean) / jnp.sqrt(var + self.epsilon)
+        return y * params["gamma"] + params["beta"]
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["epsilon"] = self.epsilon
+        return cfg

@@ -1,0 +1,192 @@
+"""Pooling layers (Max/Avg/Global × 1D/2D/3D).
+
+Parity surface: reference zoo/.../pipeline/api/keras/layers/{MaxPooling1D/2D/3D,
+AveragePooling1D/2D/3D, GlobalMaxPooling1D/2D/3D, GlobalAveragePooling1D/2D/3D}
+.scala.  All lower to ``lax.reduce_window`` in channels-last layout.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from .....core import shapes as shape_utils
+from .....core.module import Layer, register_layer
+
+
+class _PoolND(Layer):
+    rank = 2
+    mode = "max"  # or "avg"
+
+    def __init__(self, pool_size=2, strides=None, border_mode="valid",
+                 dim_ordering=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_size = shape_utils.normalize_tuple(pool_size, self.rank)
+        self.strides = (shape_utils.normalize_tuple(strides, self.rank)
+                        if strides is not None else self.pool_size)
+        self.border_mode = border_mode
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+    def _to_cl(self, x):
+        if self.data_format == "channels_first":
+            return jnp.transpose(
+                x, (0,) + tuple(range(2, 2 + self.rank)) + (1,))
+        return x
+
+    def _from_cl(self, x):
+        if self.data_format == "channels_first":
+            return jnp.transpose(
+                x, (0, self.rank + 1) + tuple(range(1, self.rank + 1)))
+        return x
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        x = self._to_cl(inputs)
+        window = (1,) + self.pool_size + (1,)
+        strides = (1,) + self.strides + (1,)
+        padding = "SAME" if self.border_mode == "same" else "VALID"
+        if self.mode == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                  padding)
+        else:
+            y = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            if padding == "SAME":
+                ones = jnp.ones_like(x)
+                counts = lax.reduce_window(ones, 0.0, lax.add, window,
+                                           strides, padding)
+                y = y / counts
+            else:
+                y = y / float(np.prod(self.pool_size))
+        return self._from_cl(y)
+
+    def compute_output_shape(self, input_shape):
+        if self.data_format == "channels_first":
+            cl = (input_shape[0],) + tuple(input_shape[2:]) + (input_shape[1],)
+        else:
+            cl = tuple(input_shape)
+        spatial = [
+            shape_utils.pool_output_length(
+                cl[1 + i], self.pool_size[i], self.border_mode,
+                self.strides[i]) for i in range(self.rank)]
+        out = (cl[0],) + tuple(spatial) + (cl[-1],)
+        if self.data_format == "channels_first":
+            return (out[0], out[-1]) + tuple(out[1:-1])
+        return out
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(pool_size=list(self.pool_size), strides=list(self.strides),
+                   border_mode=self.border_mode,
+                   dim_ordering=self.data_format)
+        return cfg
+
+
+@register_layer
+class MaxPooling1D(_PoolND):
+    rank, mode = 1, "max"
+
+    def __init__(self, pool_length=2, stride=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__(pool_size=pool_length, strides=stride,
+                         border_mode=border_mode, input_shape=input_shape,
+                         name=name)
+
+
+@register_layer
+class AveragePooling1D(_PoolND):
+    rank, mode = 1, "avg"
+
+    def __init__(self, pool_length=2, stride=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__(pool_size=pool_length, strides=stride,
+                         border_mode=border_mode, input_shape=input_shape,
+                         name=name)
+
+
+@register_layer
+class MaxPooling2D(_PoolND):
+    rank, mode = 2, "max"
+
+
+@register_layer
+class AveragePooling2D(_PoolND):
+    rank, mode = 2, "avg"
+
+
+@register_layer
+class MaxPooling3D(_PoolND):
+    rank, mode = 3, "max"
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None, border_mode="valid",
+                 dim_ordering=None, input_shape=None, name=None):
+        super().__init__(pool_size=pool_size, strides=strides,
+                         border_mode=border_mode, dim_ordering=dim_ordering,
+                         input_shape=input_shape, name=name)
+
+
+@register_layer
+class AveragePooling3D(_PoolND):
+    rank, mode = 3, "avg"
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None, border_mode="valid",
+                 dim_ordering=None, input_shape=None, name=None):
+        super().__init__(pool_size=pool_size, strides=strides,
+                         border_mode=border_mode, dim_ordering=dim_ordering,
+                         input_shape=input_shape, name=name)
+
+
+class _GlobalPoolND(Layer):
+    rank = 2
+    mode = "max"
+
+    def __init__(self, dim_ordering=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.data_format = shape_utils.normalize_data_format(dim_ordering)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if self.data_format == "channels_last":
+            axes = tuple(range(1, 1 + self.rank))
+        else:
+            axes = tuple(range(2, 2 + self.rank))
+        fn = jnp.max if self.mode == "max" else jnp.mean
+        return fn(inputs, axis=axes)
+
+    def compute_output_shape(self, input_shape):
+        ch = (input_shape[-1] if self.data_format == "channels_last"
+              else input_shape[1])
+        return (input_shape[0], ch)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["dim_ordering"] = self.data_format
+        return cfg
+
+
+@register_layer
+class GlobalMaxPooling1D(_GlobalPoolND):
+    rank, mode = 1, "max"
+
+
+@register_layer
+class GlobalAveragePooling1D(_GlobalPoolND):
+    rank, mode = 1, "avg"
+
+
+@register_layer
+class GlobalMaxPooling2D(_GlobalPoolND):
+    rank, mode = 2, "max"
+
+
+@register_layer
+class GlobalAveragePooling2D(_GlobalPoolND):
+    rank, mode = 2, "avg"
+
+
+@register_layer
+class GlobalMaxPooling3D(_GlobalPoolND):
+    rank, mode = 3, "max"
+
+
+@register_layer
+class GlobalAveragePooling3D(_GlobalPoolND):
+    rank, mode = 3, "avg"

@@ -1,0 +1,307 @@
+"""Recurrent layers: SimpleRNN, LSTM, GRU, ConvLSTM2D, Bidirectional.
+
+Parity surface: reference zoo/.../pipeline/api/keras/layers/{SimpleRNN, LSTM,
+GRU, ConvLSTM2D, Bidirectional}.scala with Keras-1 semantics
+(inner_activation default hard_sigmoid, return_sequences, go_backwards).
+
+TPU-first structure: the time loop is one ``lax.scan`` (static trip count, no
+Python unrolling), and the input projection for ALL timesteps is hoisted out
+of the scan as a single large matmul — the MXU sees one (B*T, D)x(D, 4H)
+GEMM instead of T small ones; only the recurrent H×H matmul stays inside the
+scan, which is the minimum the data dependence allows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....core import initializers
+from .....core import shapes as shape_utils
+from .....core.module import Layer, register_layer
+from .. import activations
+
+
+class _RecurrentBase(Layer):
+    gate_count = 1
+
+    def __init__(self, output_dim, activation="tanh",
+                 inner_activation="hard_sigmoid", init="glorot_uniform",
+                 inner_init="orthogonal", return_sequences=False,
+                 go_backwards=False, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = int(output_dim)
+        self.activation_name = activation
+        self.activation = activations.get(activation)
+        self.inner_activation_name = inner_activation
+        self.inner_activation = activations.get(inner_activation)
+        self.init_name = init
+        self.inner_init_name = inner_init
+        self.return_sequences = bool(return_sequences)
+        self.go_backwards = bool(go_backwards)
+
+    def init_params(self, rng, input_shape):
+        d, h, g = input_shape[-1], self.output_dim, self.gate_count
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": initializers.get(self.init_name)(k1, (d, g * h)),
+            "U": initializers.get(self.inner_init_name)(k2, (h, g * h)),
+            "b": jnp.zeros((g * h,)),
+        }
+
+    def initial_carry(self, batch):
+        h = jnp.zeros((batch, self.output_dim))
+        return h
+
+    def step(self, params, carry, z_t):
+        raise NotImplementedError
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        x = inputs
+        if self.go_backwards:
+            x = jnp.flip(x, axis=1)
+        b = x.shape[0]
+        # hoisted input projection: one big MXU GEMM over (B*T, D)
+        z = x @ params["W"] + params["b"]  # (b, t, g*h)
+        z_t = jnp.swapaxes(z, 0, 1)  # (t, b, g*h) for scan
+
+        def body(carry, zt):
+            new_carry, out = self.step(params, carry, zt)
+            return new_carry, out
+
+        _, outputs = lax.scan(body, self.initial_carry(b), z_t)
+        outputs = jnp.swapaxes(outputs, 0, 1)  # (b, t, h)
+        if self.return_sequences:
+            return outputs
+        return outputs[:, -1, :]
+
+    def compute_output_shape(self, input_shape):
+        if self.return_sequences:
+            return (input_shape[0], input_shape[1], self.output_dim)
+        return (input_shape[0], self.output_dim)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(output_dim=self.output_dim,
+                   activation=self.activation_name,
+                   inner_activation=self.inner_activation_name,
+                   init=self.init_name, inner_init=self.inner_init_name,
+                   return_sequences=self.return_sequences,
+                   go_backwards=self.go_backwards)
+        return cfg
+
+
+@register_layer
+class SimpleRNN(_RecurrentBase):
+    """Reference SimpleRNN.scala."""
+
+    gate_count = 1
+
+    def step(self, params, carry, zt):
+        h = self.activation(zt + carry @ params["U"])
+        return h, h
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.pop("inner_activation", None)
+        return cfg
+
+
+@register_layer
+class LSTM(_RecurrentBase):
+    """Reference LSTM.scala; gate order [i, f, c, o] (Keras-1)."""
+
+    gate_count = 4
+
+    def initial_carry(self, batch):
+        h = jnp.zeros((batch, self.output_dim))
+        c = jnp.zeros((batch, self.output_dim))
+        return (h, c)
+
+    def step(self, params, carry, zt):
+        h_prev, c_prev = carry
+        z = zt + h_prev @ params["U"]
+        n = self.output_dim
+        i = self.inner_activation(z[:, :n])
+        f = self.inner_activation(z[:, n:2 * n])
+        g = self.activation(z[:, 2 * n:3 * n])
+        o = self.inner_activation(z[:, 3 * n:])
+        c = f * c_prev + i * g
+        h = o * self.activation(c)
+        return (h, c), h
+
+
+@register_layer
+class GRU(_RecurrentBase):
+    """Reference GRU.scala; gate order [z, r, h] (Keras-1)."""
+
+    gate_count = 3
+
+    def step(self, params, carry, zt):
+        n = self.output_dim
+        U = params["U"]
+        z_gate = self.inner_activation(zt[:, :n] + carry @ U[:, :n])
+        r_gate = self.inner_activation(
+            zt[:, n:2 * n] + carry @ U[:, n:2 * n])
+        hh = self.activation(zt[:, 2 * n:] + (r_gate * carry) @ U[:, 2 * n:])
+        h = z_gate * carry + (1.0 - z_gate) * hh
+        return h, h
+
+
+@register_layer
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM (reference ConvLSTM2D.scala); channels-last NHWC.
+
+    Gate convolutions for all 4 gates are fused into one conv with 4*filters
+    output channels (one MXU-friendly conv per step instead of eight).
+    """
+
+    def __init__(self, nb_filter, nb_kernel=3, activation="tanh",
+                 inner_activation="hard_sigmoid", border_mode="same",
+                 subsample=1, return_sequences=False, go_backwards=False,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.kernel = shape_utils.normalize_tuple(nb_kernel, 2)
+        self.activation = activations.get(activation)
+        self.activation_name = activation
+        self.inner_activation = activations.get(inner_activation)
+        self.inner_activation_name = inner_activation
+        self.border_mode = border_mode
+        self.subsample = shape_utils.normalize_tuple(subsample, 2)
+        self.return_sequences = bool(return_sequences)
+        self.go_backwards = bool(go_backwards)
+
+    def init_params(self, rng, input_shape):
+        # input: (b, t, h, w, c)
+        c = input_shape[-1]
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": initializers.glorot_uniform(
+                k1, self.kernel + (c, 4 * self.nb_filter)),
+            "U": initializers.glorot_uniform(
+                k2, self.kernel + (self.nb_filter, 4 * self.nb_filter)),
+            "b": jnp.zeros((4 * self.nb_filter,)),
+        }
+
+    def _conv(self, x, w, strides=(1, 1)):
+        return lax.conv_general_dilated(
+            x, w, window_strides=strides,
+            padding="SAME" if self.border_mode == "same" else "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        x = inputs
+        if self.go_backwards:
+            x = jnp.flip(x, axis=1)
+        b = x.shape[0]
+        x_t = jnp.swapaxes(x, 0, 1)  # (t, b, h, w, c)
+        # spatial dims after the strided input conv
+        probe = self._conv(x_t[0], params["W"], self.subsample)
+        oh, ow = probe.shape[1], probe.shape[2]
+        h0 = jnp.zeros((b, oh, ow, self.nb_filter))
+        c0 = jnp.zeros((b, oh, ow, self.nb_filter))
+        n = self.nb_filter
+
+        def body(carry, xt):
+            h_prev, c_prev = carry
+            z = self._conv(xt, params["W"], self.subsample) \
+                + self._conv(h_prev, params["U"]) + params["b"]
+            i = self.inner_activation(z[..., :n])
+            f = self.inner_activation(z[..., n:2 * n])
+            g = self.activation(z[..., 2 * n:3 * n])
+            o = self.inner_activation(z[..., 3 * n:])
+            c_new = f * c_prev + i * g
+            h_new = o * self.activation(c_new)
+            return (h_new, c_new), h_new
+
+        _, outputs = lax.scan(body, (h0, c0), x_t)
+        outputs = jnp.swapaxes(outputs, 0, 1)
+        if self.return_sequences:
+            return outputs
+        return outputs[:, -1]
+
+    def compute_output_shape(self, input_shape):
+        b, t, h, w, _ = input_shape
+        oh = shape_utils.conv_output_length(
+            h, self.kernel[0], self.border_mode, self.subsample[0])
+        ow = shape_utils.conv_output_length(
+            w, self.kernel[1], self.border_mode, self.subsample[1])
+        if self.return_sequences:
+            return (b, t, oh, ow, self.nb_filter)
+        return (b, oh, ow, self.nb_filter)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(nb_filter=self.nb_filter, nb_kernel=list(self.kernel),
+                   activation=self.activation_name,
+                   inner_activation=self.inner_activation_name,
+                   border_mode=self.border_mode,
+                   subsample=list(self.subsample),
+                   return_sequences=self.return_sequences,
+                   go_backwards=self.go_backwards)
+        return cfg
+
+
+@register_layer
+class Bidirectional(Layer):
+    """Bidirectional wrapper (reference Bidirectional.scala)."""
+
+    def __init__(self, layer=None, merge_mode="concat", input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.layer = layer
+        self.merge_mode = merge_mode
+        # clone config for the backward direction
+        cfg = dict(layer.get_config())
+        cfg.pop("name", None)
+        cfg["go_backwards"] = not cfg.get("go_backwards", False)
+        self.backward_layer = type(layer).from_config(cfg)
+
+    def init_params(self, rng, input_shape):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "forward": self.layer.init_params(k1, input_shape),
+            "backward": self.backward_layer.init_params(k2, input_shape),
+        }
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        fwd = self.layer.call(params["forward"], {}, inputs,
+                              training=training, rng=rng)
+        bwd = self.backward_layer.call(params["backward"], {}, inputs,
+                                       training=training, rng=rng)
+        if self.layer.return_sequences:
+            bwd = jnp.flip(bwd, axis=1)  # re-align timesteps
+        if self.merge_mode == "concat":
+            return jnp.concatenate([fwd, bwd], axis=-1)
+        if self.merge_mode == "sum":
+            return fwd + bwd
+        if self.merge_mode == "mul":
+            return fwd * bwd
+        if self.merge_mode == "ave":
+            return (fwd + bwd) / 2.0
+        raise ValueError(f"Unknown merge_mode {self.merge_mode!r}")
+
+    def compute_output_shape(self, input_shape):
+        out = self.layer.compute_output_shape(input_shape)
+        if self.merge_mode == "concat":
+            return tuple(out[:-1]) + (out[-1] * 2,)
+        return out
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["merge_mode"] = self.merge_mode
+        cfg["layer"] = {"class_name": type(self.layer).__name__,
+                        "config": self.layer.get_config()}
+        return cfg
+
+    @classmethod
+    def from_config(cls, config):
+        from .....core.module import get_layer_class
+        inner = config.pop("layer")
+        layer = get_layer_class(inner["class_name"]).from_config(
+            inner["config"])
+        return cls(layer=layer, **config)

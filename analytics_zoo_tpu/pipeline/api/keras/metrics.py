@@ -1,0 +1,158 @@
+"""Validation metrics.
+
+Parity surface: reference zoo/.../pipeline/api/keras/metrics/{Accuracy,
+Top5Accuracy, AUC}.scala.  Accuracy is zero-based-label aware
+(Accuracy.scala:30); AUC uses the reference's threshold-sweep formulation
+(AUC.scala:128, thresholdNum default 200).
+
+Metrics are streaming: ``init() -> acc``, ``update(acc, y_true, y_pred) ->
+acc``, ``result(acc) -> scalar``.  The accumulator is a small pytree of jnp
+scalars, so updates run inside the jitted eval step and only ``result`` pulls
+a host value.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+
+class Metric:
+    name = "metric"
+
+    def init(self):
+        raise NotImplementedError
+
+    def update(self, acc, y_true, y_pred):
+        raise NotImplementedError
+
+    def result(self, acc):
+        raise NotImplementedError
+
+
+class Accuracy(Metric):
+    """Classification accuracy; handles scalar/int labels (zero-based) and
+    one-hot labels, binary (sigmoid) and multiclass (softmax) outputs."""
+
+    name = "accuracy"
+
+    def init(self):
+        return {"correct": jnp.zeros(()), "total": jnp.zeros(())}
+
+    def update(self, acc, y_true, y_pred):
+        if y_pred.ndim >= 2 and y_pred.shape[-1] > 1:
+            pred = jnp.argmax(y_pred, axis=-1)
+            if y_true.ndim == y_pred.ndim and y_true.shape[-1] == y_pred.shape[-1]:
+                true = jnp.argmax(y_true, axis=-1)
+            else:
+                true = jnp.squeeze(y_true).astype(jnp.int32)
+                true = true.reshape(pred.shape)
+        else:
+            pred = (jnp.squeeze(y_pred, -1) if y_pred.ndim > 1 else
+                    y_pred) > 0.5
+            true = (jnp.squeeze(y_true, -1) if y_true.ndim > 1 else
+                    y_true) > 0.5
+        correct = jnp.sum(pred == true)
+        return {"correct": acc["correct"] + correct,
+                "total": acc["total"] + pred.size}
+
+    def result(self, acc):
+        return acc["correct"] / jnp.maximum(acc["total"], 1)
+
+
+class Top5Accuracy(Metric):
+    name = "top5accuracy"
+
+    def init(self):
+        return {"correct": jnp.zeros(()), "total": jnp.zeros(())}
+
+    def update(self, acc, y_true, y_pred):
+        true = jnp.squeeze(y_true).astype(jnp.int32).reshape(-1)
+        top5 = jnp.argsort(y_pred, axis=-1)[..., -5:].reshape(len(true), 5)
+        correct = jnp.sum(jnp.any(top5 == true[:, None], axis=-1))
+        return {"correct": acc["correct"] + correct,
+                "total": acc["total"] + len(true)}
+
+    def result(self, acc):
+        return acc["correct"] / jnp.maximum(acc["total"], 1)
+
+
+class AUC(Metric):
+    """Area under ROC via threshold sweep (reference AUC.scala:128)."""
+
+    name = "auc"
+
+    def __init__(self, threshold_num: int = 200):
+        self.threshold_num = int(threshold_num)
+
+    def init(self):
+        n = self.threshold_num
+        return {"tp": jnp.zeros((n,)), "fp": jnp.zeros((n,)),
+                "pos": jnp.zeros(()), "neg": jnp.zeros(())}
+
+    def update(self, acc, y_true, y_pred):
+        scores = y_pred.reshape(-1)
+        labels = y_true.reshape(-1) > 0.5
+        thresholds = jnp.linspace(0.0, 1.0, self.threshold_num)
+        above = scores[None, :] >= thresholds[:, None]  # (n_thresh, n)
+        tp = jnp.sum(above & labels[None, :], axis=1)
+        fp = jnp.sum(above & ~labels[None, :], axis=1)
+        return {"tp": acc["tp"] + tp, "fp": acc["fp"] + fp,
+                "pos": acc["pos"] + jnp.sum(labels),
+                "neg": acc["neg"] + jnp.sum(~labels)}
+
+    def result(self, acc):
+        tpr = acc["tp"] / jnp.maximum(acc["pos"], 1)
+        fpr = acc["fp"] / jnp.maximum(acc["neg"], 1)
+        # integrate TPR over FPR (thresholds ascending -> rates descending)
+        return -jnp.trapezoid(tpr, fpr)
+
+
+class Loss(Metric):
+    """Mean loss over the validation set (reference uses BigDL Loss)."""
+
+    name = "loss"
+
+    def __init__(self, loss_fn):
+        self.loss_fn = loss_fn
+
+    def init(self):
+        return {"sum": jnp.zeros(()), "total": jnp.zeros(())}
+
+    def update(self, acc, y_true, y_pred):
+        per_sample = self.loss_fn(y_true, y_pred)
+        return {"sum": acc["sum"] + jnp.sum(per_sample),
+                "total": acc["total"] + per_sample.shape[0]}
+
+    def result(self, acc):
+        return acc["sum"] / jnp.maximum(acc["total"], 1)
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def init(self):
+        return {"sum": jnp.zeros(()), "total": jnp.zeros(())}
+
+    def update(self, acc, y_true, y_pred):
+        return {"sum": acc["sum"] + jnp.sum(jnp.abs(y_true - y_pred)),
+                "total": acc["total"] + y_pred.size}
+
+    def result(self, acc):
+        return acc["sum"] / jnp.maximum(acc["total"], 1)
+
+
+def get(name):
+    if isinstance(name, Metric):
+        return name
+    key = str(name).lower()
+    if key in ("accuracy", "acc"):
+        return Accuracy()
+    if key in ("top5accuracy", "top5", "top5acc"):
+        return Top5Accuracy()
+    if key == "auc":
+        return AUC()
+    if key == "mae":
+        return MAE()
+    raise ValueError(f"Unknown metric {name!r}")

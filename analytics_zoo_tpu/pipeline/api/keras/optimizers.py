@@ -1,0 +1,82 @@
+"""Optimizer resolution: Keras-1 strings / objects -> optax transforms.
+
+Parity surface: the reference maps strings to BigDL OptimMethods
+(zoo/.../keras/layers/utils/KerasUtils.scala ``toBigDLOptimMethod``: sgd,
+adam, adamax, adagrad, adadelta, rmsprop).  Here each resolves to an optax
+gradient transformation; gradient clipping composes in front exactly where
+the reference bolts clipping onto the Optimizer (Topology.scala:200-230).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+
+def get(optimizer, clip_norm: Optional[float] = None,
+        clip_value: Optional[tuple] = None) -> optax.GradientTransformation:
+    """Resolve an optimizer spec and compose clipping transforms.
+
+    ``optimizer`` may be a string name, an optax transformation, or a dict
+    {"name": ..., "lr"/"learning_rate": ..., extra kwargs}.
+    """
+    if isinstance(optimizer, optax.GradientTransformation):
+        opt = optimizer
+    else:
+        if isinstance(optimizer, str):
+            spec = {"name": optimizer}
+        elif isinstance(optimizer, dict):
+            spec = dict(optimizer)
+        else:
+            raise TypeError(f"Cannot resolve optimizer {optimizer!r}")
+        name = spec.pop("name").lower()
+        lr = spec.pop("lr", spec.pop("learning_rate", None))
+        schedule = _schedule(lr, spec)
+        if name == "sgd":
+            momentum = spec.pop("momentum", 0.0) or None
+            nesterov = spec.pop("nesterov", False)
+            opt = optax.sgd(schedule if schedule is not None else 0.01,
+                            momentum=momentum, nesterov=nesterov)
+        elif name == "adam":
+            opt = optax.adam(schedule if schedule is not None else 1e-3,
+                             **spec)
+        elif name == "adamax":
+            opt = optax.adamax(schedule if schedule is not None else 2e-3,
+                               **spec)
+        elif name == "adagrad":
+            opt = optax.adagrad(schedule if schedule is not None else 1e-2,
+                                **spec)
+        elif name == "adadelta":
+            opt = optax.adadelta(schedule if schedule is not None else 1.0,
+                                 **spec)
+        elif name == "rmsprop":
+            opt = optax.rmsprop(schedule if schedule is not None else 1e-3,
+                                **spec)
+        elif name in ("adamw", "lamb", "lars"):
+            opt = getattr(optax, name)(
+                schedule if schedule is not None else 1e-3, **spec)
+        else:
+            raise ValueError(f"Unknown optimizer {name!r}")
+
+    chain = []
+    if clip_value is not None:
+        # reference setConstantGradientClipping (Topology.scala:207-213)
+        chain.append(optax.clip(max(abs(clip_value[0]), abs(clip_value[1]))))
+    if clip_norm is not None:
+        # reference setGradientClippingByL2Norm (Topology.scala:219-224)
+        chain.append(optax.clip_by_global_norm(clip_norm))
+    chain.append(opt)
+    return optax.chain(*chain) if len(chain) > 1 else opt
+
+
+def _schedule(lr, spec):
+    """Build an optax schedule from lr (+ optional decay, as in the
+    reference's SGD learningRateDecay semantics)."""
+    if lr is None:
+        return None
+    decay = spec.pop("decay", spec.pop("learning_rate_decay", 0.0))
+    if decay:
+        # BigDL-style hyperbolic decay: lr / (1 + decay * step)
+        return lambda step: lr / (1.0 + decay * step)
+    return lr

@@ -1,0 +1,5 @@
+from .trainer import Trainer, TrainState
+from .triggers import (Trigger, EveryEpoch, MaxEpoch, MaxIteration,
+                       SeveralIteration, MinLoss)
+from .summary import TrainSummary, ValidationSummary, SummaryWriter
+from . import checkpoint

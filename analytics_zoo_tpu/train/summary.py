@@ -1,0 +1,125 @@
+"""Training/validation summaries (TensorBoard-compatible scalars).
+
+Parity surface: BigDL TrainSummary/ValidationSummary wired via
+``setTensorBoard(logDir, appName)`` (reference: Topology.scala:157-175,
+NNEstimator.scala:218-253).  Scalars (Loss, LearningRate, Throughput,
+validation metrics) are written as native TensorBoard event files — a
+minimal, dependency-free tfevents writer (record framing + masked CRC32c per
+the TFRecord spec) — plus a human/machine-friendly ``scalars.jsonl``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def _crc32c(data: bytes) -> int:
+    """Software CRC32C (Castagnoli), table-driven."""
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+            table.append(crc)
+        _CRC_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+_CRC_TABLE = None
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _scalar_event_proto(step: int, tag: str, value: float,
+                        wall_time: float) -> bytes:
+    """Hand-encode an Event{wall_time, step, summary{value{tag,
+    simple_value}}} protobuf (schema: tensorflow/core/util/event.proto)."""
+    tag_b = tag.encode("utf-8")
+    sv = _tag(1, 2) + _varint(len(tag_b)) + tag_b  # Summary.Value.tag = 1
+    sv += _tag(2, 5) + struct.pack("<f", value)    # simple_value = 2
+    summary = _tag(1, 2) + _varint(len(sv)) + sv   # Summary.value = 1
+    event = _tag(1, 1) + struct.pack("<d", wall_time)  # Event.wall_time = 1
+    event += _tag(2, 0) + _varint(step & 0xFFFFFFFFFFFFFFFF)  # Event.step = 2
+    event += _tag(5, 2) + _varint(len(summary)) + summary     # summary = 5
+    return event
+
+
+class SummaryWriter:
+    """Append-only tfevents + jsonl scalar writer."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.zoo_tpu"
+        self._events_path = os.path.join(log_dir, fname)
+        self._events = open(self._events_path, "ab")
+        self._jsonl = open(os.path.join(log_dir, "scalars.jsonl"), "a")
+        self._history: Dict[str, List[Tuple[int, float]]] = {}
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        wall = time.time()
+        record = _scalar_event_proto(step, tag, float(value), wall)
+        header = struct.pack("<Q", len(record))
+        self._events.write(header)
+        self._events.write(struct.pack("<I", _masked_crc(header)))
+        self._events.write(record)
+        self._events.write(struct.pack("<I", _masked_crc(record)))
+        self._jsonl.write(json.dumps(
+            {"tag": tag, "value": float(value), "step": int(step),
+             "wall_time": wall}) + "\n")
+        self._history.setdefault(tag, []).append((int(step), float(value)))
+
+    def flush(self):
+        self._events.flush()
+        self._jsonl.flush()
+
+    def close(self):
+        self._events.close()
+        self._jsonl.close()
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        """Mirror of the reference's TrainSummary.readScalar."""
+        return list(self._history.get(tag, []))
+
+
+class TrainSummary(SummaryWriter):
+    """Scalars: Loss, LearningRate, Throughput (parity with BigDL)."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(os.path.join(log_dir, app_name, "train"))
+        self.app_name = app_name
+
+
+class ValidationSummary(SummaryWriter):
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(os.path.join(log_dir, app_name, "validation"))
+        self.app_name = app_name

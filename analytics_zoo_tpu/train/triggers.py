@@ -1,0 +1,85 @@
+"""Trigger predicates: when to stop, checkpoint, or validate.
+
+Parity surface: BigDL ``Trigger`` objects consumed by the reference
+(everyEpoch, maxEpoch, maxIteration, severalIteration — used at
+Topology.scala:83-87,268-271 and NNEstimator.scala:294-307).  A Trigger is a
+pure predicate over the training record {epoch, iteration, epoch_finished}.
+"""
+
+from __future__ import annotations
+
+
+class Trigger:
+    def __call__(self, record: dict) -> bool:
+        raise NotImplementedError
+
+    # -- factories matching the reference's naming --
+    @staticmethod
+    def every_epoch():
+        return EveryEpoch()
+
+    @staticmethod
+    def max_epoch(n):
+        return MaxEpoch(n)
+
+    @staticmethod
+    def max_iteration(n):
+        return MaxIteration(n)
+
+    @staticmethod
+    def several_iteration(n):
+        return SeveralIteration(n)
+
+
+class EveryEpoch(Trigger):
+    def __call__(self, record):
+        return bool(record.get("epoch_finished", False))
+
+
+class MaxEpoch(Trigger):
+    def __init__(self, n):
+        self.n = int(n)
+
+    def __call__(self, record):
+        return record.get("epoch", 0) >= self.n
+
+
+class MaxIteration(Trigger):
+    def __init__(self, n):
+        self.n = int(n)
+
+    def __call__(self, record):
+        return record.get("iteration", 0) >= self.n
+
+
+class SeveralIteration(Trigger):
+    def __init__(self, n):
+        self.n = int(n)
+
+    def __call__(self, record):
+        it = record.get("iteration", 0)
+        return it > 0 and it % self.n == 0
+
+
+class MinLoss(Trigger):
+    def __init__(self, min_loss):
+        self.min_loss = float(min_loss)
+
+    def __call__(self, record):
+        return record.get("loss", float("inf")) <= self.min_loss
+
+
+class And(Trigger):
+    def __init__(self, *triggers):
+        self.triggers = triggers
+
+    def __call__(self, record):
+        return all(t(record) for t in self.triggers)
+
+
+class Or(Trigger):
+    def __init__(self, *triggers):
+        self.triggers = triggers
+
+    def __call__(self, record):
+        return any(t(record) for t in self.triggers)

@@ -1,0 +1,41 @@
+"""Test configuration: virtual 8-device CPU mesh.
+
+The reference tests distributed behavior with Spark local[n] (threads as
+executors, SURVEY §4); the TPU equivalent is XLA's host-platform device
+count — 8 virtual CPU devices exercise the same sharded code paths as a
+real slice, per-process.  Must be set before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# the environment's TPU tunnel plugin pre-empts JAX_PLATFORMS; force cpu
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture
+def rng():
+    import jax
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_context():
+    """Reset the process-wide NNContext between tests."""
+    yield
+    from analytics_zoo_tpu.common.context import reset_nncontext
+    reset_nncontext()
+
+
+def assert_allclose(a, b, rtol=1e-5, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol,
+                               atol=atol)
