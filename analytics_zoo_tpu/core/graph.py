@@ -261,6 +261,10 @@ class GraphModule(Layer):
             ins = ([values[p.node_id] for p in v.inputs] if len(v.inputs) > 1
                    else (values[v.inputs[0].node_id] if v.inputs else ()))
             p = params.get(layer.name, {})
+            if not layer.trainable and p:
+                # frozen layer (trainable=False / freeze semantics): block
+                # gradients so the optimizer never moves these weights
+                p = jax.tree_util.tree_map(jax.lax.stop_gradient, p)
             s = state.get(layer.name, {})
             out, s_new = layer.apply(p, s, ins, training=training, rng=r)
             if layer.stateful and s_new:

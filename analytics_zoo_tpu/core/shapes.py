@@ -61,7 +61,13 @@ def conv_output_length(
         out = input_length + dilated - 1
     else:
         raise ValueError(f"Unknown border_mode {border_mode!r}")
-    return (out + stride - 1) // stride
+    result = (out + stride - 1) // stride
+    if result <= 0:
+        raise ValueError(
+            f"Convolution output length is {result} (input {input_length}, "
+            f"filter {filter_size}, stride {stride}, {border_mode}): input "
+            "too small for this layer stack")
+    return result
 
 
 def deconv_output_length(
@@ -81,8 +87,15 @@ def pool_output_length(
     if input_length is None:
         return None
     if border_mode == "same":
-        return math.ceil(input_length / stride)
-    return (input_length - pool_size) // stride + 1
+        result = math.ceil(input_length / stride)
+    else:
+        result = (input_length - pool_size) // stride + 1
+    if result <= 0:
+        raise ValueError(
+            f"Pooling output length is {result} (input {input_length}, "
+            f"pool {pool_size}, stride {stride}, {border_mode}): input "
+            "too small for this layer stack")
+    return result
 
 
 def normalize_tuple(value, n: int, name: str = "value") -> Tuple[int, ...]:

@@ -1,0 +1,9 @@
+from .imageset import ImageSet, LocalImageSet, DistributedImageSet
+from .transforms import (
+    ImageFeature, ImageProcessing, ImageBytesToMat, ImageResize,
+    BufferedImageResize, ImageAspectScale, ImageCenterCrop, ImageRandomCrop,
+    ImageFixedCrop, ImageChannelNormalize, ImagePixelNormalizer,
+    ImageChannelOrder, ImageBrightness, ImageHue, ImageSaturation,
+    ImageContrast, ImageColorJitter, ImageExpand, ImageFiller, ImageHFlip,
+    ImageRandomPreprocessing, ImageMatToFloats, ImageMatToTensor,
+    ImageSetToSample)

@@ -1,0 +1,3 @@
+from .transforms import (ImageFeature3D, Rotate3D, AffineTransform3D,
+                         Crop3D, CenterCrop3D, RandomCrop3D,
+                         rotation_matrix)

@@ -1,0 +1,6 @@
+from .common import ZooModel, register_zoo_model
+from .textclassification import TextClassifier
+from .recommendation import (Recommender, NeuralCF, WideAndDeep,
+                             UserItemFeature, UserItemPrediction,
+                             ColumnFeatureInfo)
+from .image.classification import ImageClassifier, resnet50, label_output

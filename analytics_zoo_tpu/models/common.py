@@ -1,0 +1,45 @@
+"""ZooModel: base class of the built-in model zoo.
+
+Parity surface: reference zoo/.../models/common/ZooModel.scala:38-146 —
+``buildModel()`` defines the network, plus saveModel/loadModel,
+predictClasses and summary, all delegated to the wrapped KerasNet here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..pipeline.api.keras.engine import KerasNet, _MODEL_CLASSES
+
+
+class ZooModel(KerasNet):
+    """A predefined model whose network comes from ``build_model()``."""
+
+    def __init__(self, name=None, **hyper):
+        super().__init__(name=name)
+        self.hyper = hyper
+        self.model = self.build_model()
+
+    def build_model(self) -> KerasNet:
+        raise NotImplementedError
+
+    def to_graph(self):
+        return self.model.to_graph()
+
+    # persistence: hyperparameters + weights
+    def get_config(self):
+        return {"name": self.name, "hyper": self.hyper,
+                "compile_args": self._compile_args}
+
+    @classmethod
+    def from_config(cls, config):
+        m = cls(name=config.get("name"), **config["hyper"])
+        m._compile_args = config.get("compile_args")
+        return m
+
+def register_zoo_model(cls):
+    """Make the model loadable via KerasNet.load_model."""
+    _MODEL_CLASSES[cls.__name__] = cls
+    return cls

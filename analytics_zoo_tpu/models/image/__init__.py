@@ -1,0 +1,3 @@
+from .classification import (ImageClassifier, resnet50, vgg16, vgg19,
+                             mobilenet, mobilenet_v2, squeezenet,
+                             inception_v1, densenet161, label_output)

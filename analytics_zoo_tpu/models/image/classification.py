@@ -1,0 +1,335 @@
+"""ImageClassifier model zoo: ResNet-50, VGG-16/19, MobileNet v1/v2,
+SqueezeNet, Inception-v1, DenseNet-161.
+
+Parity surface: reference zoo/.../models/image/imageclassification/
+{ImageClassifier.scala, ImageClassificationConfig.scala:34-50} — a named
+registry of architectures with pre/postprocessing configs (the reference
+ships pretrained BigDL weights per name; here the architectures are built
+natively and weights train or load from checkpoints).
+
+TPU-first notes: all nets are NHWC; ResNet uses fused conv+BN blocks that
+XLA folds into single MXU convolutions; bottleneck widths are multiples of
+128 so tiles fill the 128x128 systolic array.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ...core.graph import Input
+from ...pipeline.api.keras.engine import Model
+from ...pipeline.api.keras.layers import (
+    Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
+    Dropout, Flatten, GlobalAveragePooling2D, MaxPooling2D, Merge,
+    SeparableConvolution2D, ZeroPadding2D)
+from ..common import ZooModel, register_zoo_model
+
+
+def _conv_bn(x, filters, kernel, stride=1, padding="same", activation="relu",
+             name=None, bias=False):
+    x = Convolution2D(filters, kernel, kernel, subsample=(stride, stride),
+                      border_mode=padding, bias=bias, name=name)(x)
+    x = BatchNormalization(name=None if name is None else name + "_bn")(x)
+    if activation:
+        x = Activation(activation)(x)
+    return x
+
+
+# ---------------------------------------------------------------- ResNet-50
+
+def _bottleneck(x, filters, stride=1, downsample=False, prefix=""):
+    shortcut = x
+    if downsample:
+        shortcut = _conv_bn(x, filters * 4, 1, stride=stride,
+                            activation=None, name=f"{prefix}_proj")
+    y = _conv_bn(x, filters, 1, stride=stride, name=f"{prefix}_1")
+    y = _conv_bn(y, filters, 3, name=f"{prefix}_2")
+    y = _conv_bn(y, filters * 4, 1, activation=None, name=f"{prefix}_3")
+    out = Merge(mode="sum")([y, shortcut])
+    return Activation("relu")(out)
+
+
+def resnet50(input_shape=(224, 224, 3), num_classes=1000) -> Model:
+    """ResNet-50 v1 (the reference registry's 'resnet-50',
+    ImageClassificationConfig.scala:40)."""
+    inp = Input(input_shape, name="image")
+    x = ZeroPadding2D(padding=(3, 3))(inp)
+    x = _conv_bn(x, 64, 7, stride=2, padding="valid", name="conv1")
+    x = ZeroPadding2D(padding=(1, 1))(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    stages = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
+    for s, (filters, blocks, stride) in enumerate(stages):
+        x = _bottleneck(x, filters, stride=stride, downsample=True,
+                        prefix=f"res{s}b0")
+        for b in range(1, blocks):
+            x = _bottleneck(x, filters, prefix=f"res{s}b{b}")
+    x = GlobalAveragePooling2D()(x)
+    x = Dense(num_classes, activation="softmax", name="fc1000")(x)
+    return Model(input=inp, output=x, name="resnet50")
+
+
+# ---------------------------------------------------------------- VGG
+
+def _vgg(cfg: List, input_shape, num_classes) -> Model:
+    inp = Input(input_shape, name="image")
+    x = inp
+    for i, block in enumerate(cfg):
+        for j in range(block[0]):
+            x = Convolution2D(block[1], 3, 3, activation="relu",
+                              border_mode="same",
+                              name=f"block{i + 1}_conv{j + 1}")(x)
+        x = MaxPooling2D()(x)
+    x = Flatten()(x)
+    x = Dense(4096, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    x = Dense(4096, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    x = Dense(num_classes, activation="softmax")(x)
+    return Model(input=inp, output=x, name="vgg")
+
+
+def vgg16(input_shape=(224, 224, 3), num_classes=1000):
+    return _vgg([(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)],
+                input_shape, num_classes)
+
+
+def vgg19(input_shape=(224, 224, 3), num_classes=1000):
+    return _vgg([(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)],
+                input_shape, num_classes)
+
+
+# ---------------------------------------------------------------- MobileNet
+
+def mobilenet(input_shape=(224, 224, 3), num_classes=1000, alpha=1.0):
+    inp = Input(input_shape, name="image")
+    x = _conv_bn(inp, int(32 * alpha), 3, stride=2)
+    cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+           (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+           (1024, 1)]
+    for filters, stride in cfg:
+        x = SeparableConvolution2D(int(filters * alpha), 3, 3,
+                                   border_mode="same",
+                                   subsample=(stride, stride))(x)
+        x = BatchNormalization()(x)
+        x = Activation("relu6")(x)
+    x = GlobalAveragePooling2D()(x)
+    x = Dense(num_classes, activation="softmax")(x)
+    return Model(input=inp, output=x, name="mobilenet")
+
+
+def _inverted_residual(x, in_ch, filters, stride, expansion, prefix):
+    hidden = in_ch * expansion
+    y = _conv_bn(x, hidden, 1, activation="relu6",
+                 name=f"{prefix}_expand") if expansion != 1 else x
+    y = SeparableConvolution2D(filters, 3, 3, border_mode="same",
+                               subsample=(stride, stride),
+                               depth_multiplier=1,
+                               name=f"{prefix}_dw")(y)
+    y = BatchNormalization()(y)
+    # no activation after the linear bottleneck projection (v2 design)
+    if stride == 1 and in_ch == filters:
+        return Merge(mode="sum")([x, y])
+    return y
+
+
+def mobilenet_v2(input_shape=(224, 224, 3), num_classes=1000):
+    inp = Input(input_shape, name="image")
+    x = _conv_bn(inp, 32, 3, stride=2, activation="relu6")
+    in_ch = 32
+    cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+           (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+    for bi, (t, c, n, s) in enumerate(cfg):
+        for i in range(n):
+            x = _inverted_residual(x, in_ch, c, s if i == 0 else 1, t,
+                                   prefix=f"ir{bi}_{i}")
+            in_ch = c
+    x = _conv_bn(x, 1280, 1, activation="relu6")
+    x = GlobalAveragePooling2D()(x)
+    x = Dense(num_classes, activation="softmax")(x)
+    return Model(input=inp, output=x, name="mobilenet_v2")
+
+
+# ---------------------------------------------------------------- SqueezeNet
+
+def _fire(x, squeeze, expand, prefix):
+    s = Convolution2D(squeeze, 1, 1, activation="relu",
+                      name=f"{prefix}_s1")(x)
+    e1 = Convolution2D(expand, 1, 1, activation="relu",
+                       name=f"{prefix}_e1")(s)
+    e3 = Convolution2D(expand, 3, 3, activation="relu", border_mode="same",
+                       name=f"{prefix}_e3")(s)
+    return Merge(mode="concat", concat_axis=-1)([e1, e3])
+
+
+def squeezenet(input_shape=(224, 224, 3), num_classes=1000):
+    inp = Input(input_shape, name="image")
+    x = Convolution2D(64, 3, 3, subsample=(2, 2), activation="relu")(inp)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = _fire(x, 16, 64, "fire2")
+    x = _fire(x, 16, 64, "fire3")
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = _fire(x, 32, 128, "fire4")
+    x = _fire(x, 32, 128, "fire5")
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = _fire(x, 48, 192, "fire6")
+    x = _fire(x, 48, 192, "fire7")
+    x = _fire(x, 64, 256, "fire8")
+    x = _fire(x, 64, 256, "fire9")
+    x = Dropout(0.5)(x)
+    x = Convolution2D(num_classes, 1, 1, activation="relu")(x)
+    x = GlobalAveragePooling2D()(x)
+    x = Activation("softmax")(x)
+    return Model(input=inp, output=x, name="squeezenet")
+
+
+# ---------------------------------------------------------------- Inception
+
+def _inception_block(x, b1, b3r, b3, b5r, b5, pp, prefix):
+    branch1 = Convolution2D(b1, 1, 1, activation="relu",
+                            name=f"{prefix}_1x1")(x)
+    branch3 = Convolution2D(b3r, 1, 1, activation="relu",
+                            name=f"{prefix}_3x3r")(x)
+    branch3 = Convolution2D(b3, 3, 3, activation="relu", border_mode="same",
+                            name=f"{prefix}_3x3")(branch3)
+    branch5 = Convolution2D(b5r, 1, 1, activation="relu",
+                            name=f"{prefix}_5x5r")(x)
+    branch5 = Convolution2D(b5, 5, 5, activation="relu", border_mode="same",
+                            name=f"{prefix}_5x5")(branch5)
+    pool = MaxPooling2D(pool_size=(3, 3), strides=(1, 1),
+                        border_mode="same")(x)
+    pool = Convolution2D(pp, 1, 1, activation="relu",
+                         name=f"{prefix}_pool")(pool)
+    return Merge(mode="concat", concat_axis=-1)(
+        [branch1, branch3, branch5, pool])
+
+
+def inception_v1(input_shape=(224, 224, 3), num_classes=1000):
+    """GoogLeNet (the reference registry's 'inception-v1')."""
+    inp = Input(input_shape, name="image")
+    x = Convolution2D(64, 7, 7, subsample=(2, 2), activation="relu",
+                      border_mode="same")(inp)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     border_mode="same")(x)
+    x = Convolution2D(64, 1, 1, activation="relu")(x)
+    x = Convolution2D(192, 3, 3, activation="relu", border_mode="same")(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     border_mode="same")(x)
+    x = _inception_block(x, 64, 96, 128, 16, 32, 32, "i3a")
+    x = _inception_block(x, 128, 128, 192, 32, 96, 64, "i3b")
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     border_mode="same")(x)
+    x = _inception_block(x, 192, 96, 208, 16, 48, 64, "i4a")
+    x = _inception_block(x, 160, 112, 224, 24, 64, 64, "i4b")
+    x = _inception_block(x, 128, 128, 256, 24, 64, 64, "i4c")
+    x = _inception_block(x, 112, 144, 288, 32, 64, 64, "i4d")
+    x = _inception_block(x, 256, 160, 320, 32, 128, 128, "i4e")
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     border_mode="same")(x)
+    x = _inception_block(x, 256, 160, 320, 32, 128, 128, "i5a")
+    x = _inception_block(x, 384, 192, 384, 48, 128, 128, "i5b")
+    x = GlobalAveragePooling2D()(x)
+    x = Dropout(0.4)(x)
+    x = Dense(num_classes, activation="softmax")(x)
+    return Model(input=inp, output=x, name="inception_v1")
+
+
+# ---------------------------------------------------------------- DenseNet
+
+def _dense_block(x, layers, growth, prefix):
+    for i in range(layers):
+        y = BatchNormalization()(x)
+        y = Activation("relu")(y)
+        y = Convolution2D(4 * growth, 1, 1, bias=False)(y)
+        y = BatchNormalization()(y)
+        y = Activation("relu")(y)
+        y = Convolution2D(growth, 3, 3, border_mode="same", bias=False,
+                          name=f"{prefix}_l{i}")(y)
+        x = Merge(mode="concat", concat_axis=-1)([x, y])
+    return x
+
+
+def _transition(x, out_ch):
+    x = BatchNormalization()(x)
+    x = Activation("relu")(x)
+    x = Convolution2D(out_ch, 1, 1, bias=False)(x)
+    return AveragePooling2D(pool_size=(2, 2))(x)
+
+
+def densenet161(input_shape=(224, 224, 3), num_classes=1000):
+    growth, init_ch = 48, 96
+    inp = Input(input_shape, name="image")
+    x = ZeroPadding2D(padding=(3, 3))(inp)
+    x = Convolution2D(init_ch, 7, 7, subsample=(2, 2), bias=False)(x)
+    x = BatchNormalization()(x)
+    x = Activation("relu")(x)
+    x = ZeroPadding2D(padding=(1, 1))(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    ch = init_ch
+    for bi, layers in enumerate([6, 12, 36, 24]):
+        x = _dense_block(x, layers, growth, f"db{bi}")
+        ch += layers * growth
+        if bi < 3:
+            ch //= 2
+            x = _transition(x, ch)
+    x = BatchNormalization()(x)
+    x = Activation("relu")(x)
+    x = GlobalAveragePooling2D()(x)
+    x = Dense(num_classes, activation="softmax")(x)
+    return Model(input=inp, output=x, name="densenet161")
+
+
+# ---------------------------------------------------------------- registry
+
+_ARCHITECTURES: Dict[str, Callable] = {
+    "resnet-50": resnet50,
+    "vgg-16": vgg16,
+    "vgg-19": vgg19,
+    "mobilenet": mobilenet,
+    "mobilenet-v2": mobilenet_v2,
+    "squeezenet": squeezenet,
+    "inception-v1": inception_v1,
+    "densenet-161": densenet161,
+}
+
+
+@register_zoo_model
+class ImageClassifier(ZooModel):
+    """Named-architecture image classifier
+    (reference ImageClassifier.scala + config registry)."""
+
+    def __init__(self, model_name="resnet-50", input_shape=(224, 224, 3),
+                 num_classes=1000, name=None, **kw):
+        if model_name not in _ARCHITECTURES:
+            raise ValueError(
+                f"Unknown model {model_name!r}; known: "
+                f"{sorted(_ARCHITECTURES)}")
+        super().__init__(name=name, model_name=model_name,
+                         input_shape=tuple(input_shape),
+                         num_classes=num_classes, **kw)
+
+    def build_model(self) -> Model:
+        h = self.hyper
+        return _ARCHITECTURES[h["model_name"]](
+            input_shape=h["input_shape"], num_classes=h["num_classes"])
+
+    def predict_image_set(self, image_set, configure=None):
+        """predictImageSet parity (ImageModel.scala:45-69): preprocess →
+        predict → attach results."""
+        from ...feature.image.imageset import ImageSet
+        x = image_set.to_array()
+        probs = self.predict(x, batch_size=32)
+        image_set.set_predictions(probs)
+        return image_set
+
+
+def label_output(probs, labels: Optional[List[str]] = None, top_k: int = 5):
+    """LabelOutput parity (reference LabelOutput.scala): top-k (label,
+    confidence) per image."""
+    import numpy as np
+    probs = np.asarray(probs)
+    idx = np.argsort(-probs, axis=-1)[:, :top_k]
+    out = []
+    for row, ids in zip(probs, idx):
+        out.append([
+            (labels[i] if labels else int(i), float(row[i])) for i in ids])
+    return out

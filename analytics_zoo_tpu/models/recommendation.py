@@ -1,0 +1,247 @@
+"""Recommendation models: Recommender base, NeuralCF, WideAndDeep.
+
+Parity surface: reference zoo/.../models/recommendation/
+{Recommender.scala:36-96, NeuralCF.scala:43-95, WideAndDeep.scala:80-165,
+Utils.scala}.  The graph structure follows the reference exactly (MLP +
+optional MF branch fused by concat; wide sparse-linear + deep tower fused by
+add + log-softmax); lookups are jnp gathers, the towers are MXU matmuls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..pipeline.api import autograd as A
+from ..pipeline.api.keras.engine import Model
+from ..pipeline.api.keras.layers import Dense, Embedding
+from ..core.graph import Input
+from .common import ZooModel, register_zoo_model
+
+
+@dataclasses.dataclass
+class UserItemFeature:
+    """Parity: reference UserItemFeature (user id, item id, sample)."""
+
+    user_id: int
+    item_id: int
+    feature: object  # model input (np array / tuple)
+    label: Optional[int] = None
+
+
+@dataclasses.dataclass
+class UserItemPrediction:
+    user_id: int
+    item_id: int
+    prediction: int
+    probability: float
+
+
+@dataclasses.dataclass
+class ColumnFeatureInfo:
+    """Parity: reference ColumnFeatureInfo (WideAndDeep.scala:38-78)."""
+
+    wide_base_cols: Sequence[str] = ()
+    wide_base_dims: Sequence[int] = ()
+    wide_cross_cols: Sequence[str] = ()
+    wide_cross_dims: Sequence[int] = ()
+    indicator_cols: Sequence[str] = ()
+    indicator_dims: Sequence[int] = ()
+    embed_cols: Sequence[str] = ()
+    embed_in_dims: Sequence[int] = ()
+    embed_out_dims: Sequence[int] = ()
+    continuous_cols: Sequence[str] = ()
+
+
+class Recommender(ZooModel):
+    """recommendForUser / recommendForItem / predictUserItemPair
+    (reference Recommender.scala:36-96)."""
+
+    def predict_user_item_pair(self, feature_pairs: Sequence[UserItemFeature],
+                               batch_size: int = 128
+                               ) -> List[UserItemPrediction]:
+        feats = [p.feature for p in feature_pairs]
+        x = (tuple(np.stack([f[i] for f in feats])
+                   for i in range(len(feats[0])))
+             if isinstance(feats[0], (tuple, list)) else np.stack(feats))
+        probs = np.asarray(self.predict(x, batch_size=batch_size))
+        # model emits log-probabilities (log-softmax, reference parity)
+        probs = np.exp(probs)
+        preds = np.argmax(probs, axis=-1)
+        return [
+            UserItemPrediction(p.user_id, p.item_id, int(c) + 1,
+                               float(pr[c]))
+            for p, c, pr in zip(feature_pairs, preds, probs)]
+
+    def recommend_for_user(self, feature_pairs: Sequence[UserItemFeature],
+                           max_items: int) -> List[UserItemPrediction]:
+        preds = self.predict_user_item_pair(feature_pairs)
+        by_user = {}
+        for pred in preds:
+            by_user.setdefault(pred.user_id, []).append(pred)
+        out = []
+        for user, items in by_user.items():
+            items.sort(key=lambda r: -r.probability)
+            out.extend(items[:max_items])
+        return out
+
+    def recommend_for_item(self, feature_pairs: Sequence[UserItemFeature],
+                           max_users: int) -> List[UserItemPrediction]:
+        preds = self.predict_user_item_pair(feature_pairs)
+        by_item = {}
+        for pred in preds:
+            by_item.setdefault(pred.item_id, []).append(pred)
+        out = []
+        for item, users in by_item.items():
+            users.sort(key=lambda r: -r.probability)
+            out.extend(users[:max_users])
+        return out
+
+
+@register_zoo_model
+class NeuralCF(Recommender):
+    """Neural Collaborative Filtering (reference NeuralCF.scala:43-95).
+
+    Input: int tensor (batch, 2) of 1-based [user_id, item_id].
+    Output: log-softmax over num_classes.
+    """
+
+    def __init__(self, user_count=None, item_count=None, num_classes=None,
+                 user_embed=20, item_embed=20, hidden_layers=(40, 20, 10),
+                 include_mf=True, mf_embed=20, name=None, **kw):
+        super().__init__(name=name, user_count=user_count,
+                         item_count=item_count, num_classes=num_classes,
+                         user_embed=user_embed, item_embed=item_embed,
+                         hidden_layers=tuple(hidden_layers),
+                         include_mf=include_mf, mf_embed=mf_embed, **kw)
+
+    def build_model(self) -> Model:
+        h = self.hyper
+        pair = Input((2,), name=f"{self.name}_pair")
+        user = pair.index_select(1, 0)  # (batch,)
+        item = pair.index_select(1, 1)
+        # +1: ids are 1-based (reference LookupTable semantics)
+        mlp_user = Embedding(h["user_count"] + 1, h["user_embed"],
+                             init="normal")(user)
+        mlp_item = Embedding(h["item_count"] + 1, h["item_embed"],
+                             init="normal")(item)
+        merged = A.concat([mlp_user, mlp_item], axis=-1)
+        for width in h["hidden_layers"]:
+            merged = Dense(width, activation="relu")(merged)
+        if h["include_mf"]:
+            if h["mf_embed"] <= 0:
+                raise ValueError(
+                    "please provide meaningful number of embedding units")
+            mf_user = Embedding(h["user_count"] + 1, h["mf_embed"],
+                                init="normal")(user)
+            mf_item = Embedding(h["item_count"] + 1, h["mf_embed"],
+                                init="normal")(item)
+            mf = mf_user * mf_item
+            merged = A.concat([mf, merged], axis=-1)
+        logits = Dense(h["num_classes"])(merged)
+        from ..pipeline.api.keras.layers import Activation
+        log_probs = Activation("log_softmax")(logits)
+        return Model(input=pair, output=log_probs,
+                     name=f"{self.name}_net")
+
+
+@register_zoo_model
+class WideAndDeep(Recommender):
+    """Wide & Deep (reference WideAndDeep.scala:80-165).
+
+    Inputs (matching the reference's assembled tensors, Utils.scala
+    getWide/getDeep):
+      wide input  — int ids (batch, n_wide_cols), each id pre-offset into
+                    the concatenated wide dimension space (base + cross);
+      deep input  — floats (batch, indicator_width + n_embed_cols +
+                    n_continuous): multi-hot indicators, then embed ids,
+                    then continuous values.
+    Output: log-softmax over num_classes.
+    """
+
+    def __init__(self, model_type="wide_n_deep", num_classes=None,
+                 column_info: Optional[ColumnFeatureInfo] = None,
+                 hidden_layers=(40, 20, 10), name=None, **kw):
+        if column_info is not None:
+            # flatten ColumnFeatureInfo into plain hypers so get_config /
+            # from_config round-trips without the dataclass
+            ci = (ColumnFeatureInfo(**column_info)
+                  if isinstance(column_info, dict) else column_info)
+            kw.update(
+                wide_base_dims=tuple(ci.wide_base_dims),
+                wide_cross_dims=tuple(ci.wide_cross_dims),
+                indicator_dims=tuple(ci.indicator_dims),
+                embed_in_dims=tuple(ci.embed_in_dims),
+                embed_out_dims=tuple(ci.embed_out_dims),
+                n_continuous=len(ci.continuous_cols))
+        kw.setdefault("wide_base_dims", ())
+        kw.setdefault("wide_cross_dims", ())
+        kw.setdefault("indicator_dims", ())
+        kw.setdefault("embed_in_dims", ())
+        kw.setdefault("embed_out_dims", ())
+        kw.setdefault("n_continuous", 0)
+        kw = {k: (tuple(v) if isinstance(v, list) else v)
+              for k, v in kw.items()}
+        super().__init__(
+            name=name, model_type=model_type, num_classes=num_classes,
+            hidden_layers=tuple(hidden_layers), **kw)
+
+    def build_model(self) -> Model:
+        h = self.hyper
+        num_classes = h["num_classes"]
+        model_type = h["model_type"]
+        wide_total = sum(h["wide_base_dims"]) + sum(h["wide_cross_dims"])
+        n_wide_cols = len(h["wide_base_dims"]) + len(h["wide_cross_dims"])
+        indicator_width = sum(h["indicator_dims"])
+        n_embed = len(h["embed_in_dims"])
+        n_cont = h["n_continuous"]
+
+        inputs, wide_out, deep_out = [], None, None
+
+        if model_type in ("wide", "wide_n_deep"):
+            wide_in = Input((n_wide_cols,), name=f"{self.name}_wide")
+            inputs.append(wide_in)
+            # sparse linear: sum one-hot(id) @ W == sum of embedding rows
+            # (reference LookupTableSparse init Zeros + CAdd bias)
+            wide_embed = Embedding(wide_total + 1, num_classes,
+                                   init="zero")(wide_in)
+            wide_sum = A.sum(wide_embed, axis=1)  # (batch, num_classes)
+            bias = A.Parameter((num_classes,), init_method="zero",
+                               name=f"{self.name}_wide_bias")
+            wide_out = wide_sum + bias
+
+        if model_type in ("deep", "wide_n_deep"):
+            deep_width = indicator_width + n_embed + n_cont
+            deep_in = Input((deep_width,), name=f"{self.name}_deep")
+            inputs.append(deep_in)
+            parts = []
+            if indicator_width:
+                parts.append(deep_in.slice(1, 0, indicator_width))
+            for i, (in_dim, out_dim) in enumerate(
+                    zip(h["embed_in_dims"], h["embed_out_dims"])):
+                ids = deep_in.index_select(1, indicator_width + i)
+                parts.append(Embedding(in_dim + 1, out_dim,
+                                       init="normal")(ids))
+            if n_cont:
+                parts.append(deep_in.slice(
+                    1, indicator_width + n_embed, n_cont))
+            deep = parts[0] if len(parts) == 1 else A.concat(parts, axis=-1)
+            for width in h["hidden_layers"]:
+                deep = Dense(width, activation="relu")(deep)
+            deep_out = Dense(num_classes)(deep)
+
+        if model_type == "wide_n_deep":
+            logits = wide_out + deep_out
+        elif model_type == "wide":
+            logits = wide_out
+        elif model_type == "deep":
+            logits = deep_out
+        else:
+            raise ValueError(f"unknown type {model_type!r}")
+        from ..pipeline.api.keras.layers import Activation
+        out = Activation("log_softmax")(logits)
+        return Model(input=inputs if len(inputs) > 1 else inputs[0],
+                     output=out, name=f"{self.name}_net")

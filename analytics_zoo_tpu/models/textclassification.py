@@ -1,0 +1,63 @@
+"""TextClassifier model.
+
+Parity surface: reference zoo/.../models/textclassification/
+TextClassifier.scala:31-60 — embedding (optional WordEmbedding) →
+{CNN(Conv1D 256,k=5 + GlobalMaxPooling1D) | LSTM | GRU} encoder →
+Dense(128) → Dropout(0.2) → ReLU → Dense(classNum, softmax);
+sequenceLength default 500.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..pipeline.api.keras.engine import Sequential
+from ..pipeline.api.keras.layers import (
+    Activation, Convolution1D, Dense, Dropout, GlobalMaxPooling1D, GRU,
+    LSTM, WordEmbedding)
+from .common import ZooModel, register_zoo_model
+
+
+@register_zoo_model
+class TextClassifier(ZooModel):
+    def __init__(self, class_num=None, token_length=None,
+                 sequence_length=500, encoder="cnn", encoder_output_dim=256,
+                 embedding_file=None, word_index=None, name=None, **kw):
+        super().__init__(name=name, class_num=class_num,
+                         token_length=token_length,
+                         sequence_length=sequence_length, encoder=encoder,
+                         encoder_output_dim=encoder_output_dim,
+                         embedding_file=embedding_file,
+                         word_index=word_index, **kw)
+
+    def build_model(self) -> Sequential:
+        h = self.hyper
+        model = Sequential(name=f"{self.name}_net")
+        if h.get("embedding_file"):
+            model.add(WordEmbedding(
+                h["embedding_file"], word_index=h.get("word_index"),
+                input_length=h["sequence_length"]))
+            first_shape = None  # embedding provides the input
+        else:
+            # pre-embedded input (sequence_length, token_length), matching
+            # the reference's InputLayer branch
+            first_shape = (h["sequence_length"], h["token_length"])
+
+        enc = h["encoder"].lower()
+        dim = h["encoder_output_dim"]
+        if enc == "cnn":
+            model.add(Convolution1D(dim, 5, activation="relu",
+                                    input_shape=first_shape))
+            model.add(GlobalMaxPooling1D())
+        elif enc == "lstm":
+            model.add(LSTM(dim, input_shape=first_shape))
+        elif enc == "gru":
+            model.add(GRU(dim, input_shape=first_shape))
+        else:
+            raise ValueError(
+                f"Unsupported encoder for TextClassifier: {h['encoder']}")
+        model.add(Dense(128))
+        model.add(Dropout(0.2))
+        model.add(Activation("relu"))
+        model.add(Dense(h["class_num"], activation="softmax"))
+        return model

@@ -1,0 +1,223 @@
+"""autograd DSL: Variable ops, Parameter, Lambda, CustomLoss.
+
+Parity surface: reference zoo/.../pipeline/api/autograd (math.scala:32-567,
+KerasParameter.scala:31-67, Lambda.scala:49, CustomLoss.scala:29-66) and the
+python mirror pyzoo/zoo/pipeline/api/autograd.py:31-559.
+
+The reference's "autograd" is graph-node composition whose backward is each
+wrapped BigDL module's hand-written updateGradInput — NOT tape autodiff.
+Here every op is a node in the same symbolic graph the functional API uses
+(core/graph.py) and differentiation is real ``jax.grad`` through the traced
+computation, so custom losses/layers need no per-op backward definitions.
+
+Axis convention: axes index the full array (batch = axis 0), matching jnp.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import shapes as shape_utils
+from ...core.graph import GraphModule, Input, Variable
+from ...core.module import Layer, register_layer
+from ...ops import elementwise as _ops
+
+# ---- module-level ops (reference autograd.py:31-246) ----
+abs = _ops.abs  # noqa: A001
+sum = _ops.sum  # noqa: A001
+clip = _ops.clip
+square = _ops.square
+sqrt = _ops.sqrt
+maximum = _ops.maximum
+minimum = _ops.minimum
+mean = _ops.mean
+max = _ops.max  # noqa: A001
+min = _ops.min  # noqa: A001
+log = _ops.log
+exp = _ops.exp
+pow = _ops.pow  # noqa: A001
+softsign = _ops.softsign
+softplus = _ops.softplus
+stack = _ops.stack
+concat = _ops.concat
+expand_dims = _ops.expand_dims
+squeeze = _ops.squeeze
+contiguous = _ops.contiguous
+mm = _ops.mm
+batch_dot = _ops.batch_dot
+l2_normalize = _ops.l2_normalize
+constant = _ops.constant
+relu = _ops.relu
+sigmoid = _ops.sigmoid
+tanh = _ops.tanh
+slice = _ops.slice  # noqa: A001
+index_select = _ops.index_select
+
+
+def epsilon() -> float:
+    """Fuzz factor (reference AutoGrad.epsilon, math.scala:116)."""
+    return _ops.epsilon()
+
+
+@register_layer
+class ParameterLayer(Layer):
+    """Zero-input node holding a standalone trainable weight
+    (reference KerasParameter.scala:31-67)."""
+
+    is_source = True
+
+    def __init__(self, shape=None, init_method="glorot_uniform",
+                 init_weight=None, name=None, input_shape=None,
+                 trainable=True):
+        super().__init__(name=name, input_shape=input_shape,
+                         trainable=trainable)
+        self.shape = tuple(int(d) for d in shape)
+        self.init_method = init_method
+        self.init_weight = (np.asarray(init_weight, dtype=np.float32)
+                            if init_weight is not None else None)
+
+    def init_params(self, rng, input_shape):
+        from ...core import initializers
+        if self.init_weight is not None:
+            w = jnp.asarray(self.init_weight)
+        else:
+            w = initializers.get(self.init_method)(rng, self.shape)
+        return {"weight": w}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return params["weight"]
+
+    def compute_output_shape(self, input_shape):
+        return self.shape
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(shape=list(self.shape), init_method=self.init_method,
+                   init_weight=None if self.init_weight is None
+                   else self.init_weight.tolist(),
+                   trainable=self.trainable)
+        return cfg
+
+
+def Parameter(shape, init_method="glorot_uniform", init_weight=None,
+              name=None) -> Variable:
+    """Create a trainable weight Variable usable inside expressions
+    (reference autograd.py:455 Parameter).  Shape has NO batch dim."""
+    layer = ParameterLayer(shape=shape, init_method=init_method,
+                           init_weight=init_weight, name=name)
+    return Variable(layer, (), tuple(layer.shape), name=layer.name)
+
+
+@register_layer
+class Lambda(Layer):
+    """User function as a layer (reference Lambda.scala:49,
+    autograd.py:397).
+
+    The function receives jnp arrays (single input) or a list of them and
+    returns a jnp array; output shape is inferred by abstract tracing
+    (``jax.eval_shape``) so the graph stays statically shaped.  Note:
+    functions are not serializable — models containing Lambda layers
+    save/load weights but need the code to rebuild (same restriction the
+    reference has in practice: Lambda closures never round-trip the bridge).
+    """
+
+    stochastic = True
+
+    def __init__(self, function: Callable = None, input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        if function is None:
+            raise ValueError("Lambda requires a function")
+        self.function = function
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if isinstance(inputs, (list, tuple)):
+            return self.function(*inputs)
+        return self.function(inputs)
+
+    def compute_output_shape(self, input_shape):
+        multi = isinstance(input_shape[0], (tuple, list))
+        shapes = input_shape if multi else [input_shape]
+        dummies = [
+            jax.ShapeDtypeStruct(
+                tuple(2 if d is None else d for d in s), jnp.float32)
+            for s in shapes]
+        out = jax.eval_shape(lambda *xs: self.function(*xs), *dummies)
+        batch_unknown = shapes[0][0] is None
+        out_shape = tuple(out.shape)
+        if batch_unknown and len(out_shape) > 0:
+            return (None,) + out_shape[1:]
+        return out_shape
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["function"] = None  # not serializable
+        return cfg
+
+
+class CustomLoss:
+    """Build a loss from an expression over (y_true, y_pred)
+    (reference CustomLoss.scala:29-66, autograd.py:501).
+
+    ``loss_func(y_true, y_pred)`` receives jnp arrays (full batch) and
+    returns per-sample losses or a scalar.  Instances are callable with the
+    trainer's (y_true, y_pred) signature, so they slot directly into
+    ``compile(loss=...)``.  ``from_variables`` supports the reference's
+    Variable-expression form (CustomLossWithVariable).
+    """
+
+    def __init__(self, loss_func: Callable, y_pred_shape=None,
+                 y_true_shape=None):
+        self.loss_func = loss_func
+        self.y_pred_shape = y_pred_shape
+        self.y_true_shape = y_true_shape
+
+    @classmethod
+    def from_variables(cls, y_true: Variable, y_pred: Variable,
+                       loss: Variable) -> "CustomLoss":
+        graph = GraphModule([y_true, y_pred], loss, name="custom_loss")
+        params, state = graph.init(jax.random.PRNGKey(0))
+
+        def fn(yt, yp):
+            out, _ = graph.apply(params, state, [yt, yp], training=False)
+            return out
+
+        return cls(fn)
+
+    def __call__(self, y_true, y_pred):
+        out = self.loss_func(y_true, y_pred)
+        out = jnp.asarray(out)
+        if out.ndim == 0:
+            # scalar loss -> broadcast per-sample for the trainer's mean
+            batch = (y_pred[0] if isinstance(y_pred, (list, tuple))
+                     else y_pred).shape[0]
+            return jnp.broadcast_to(out, (batch,))
+        if out.ndim > 1:
+            return jnp.mean(out, axis=tuple(range(1, out.ndim)))
+        return out
+
+    def forward(self, y_true, y_pred):
+        """Reference CustomLoss.forward parity: mean scalar loss."""
+        return float(jnp.mean(self(jnp.asarray(y_true),
+                                   jnp.asarray(y_pred))))
+
+    def backward(self, y_true, y_pred):
+        """Reference CustomLoss.backward parity: d(mean loss)/d(y_pred) —
+        real autodiff instead of the reference's module backward."""
+        grad_fn = jax.grad(
+            lambda yp: jnp.mean(self(jnp.asarray(y_true), yp)))
+        return np.asarray(grad_fn(jnp.asarray(y_pred)))
+
+
+__all__ = [
+    "Variable", "Input", "Parameter", "ParameterLayer", "Lambda",
+    "CustomLoss", "constant", "abs", "sum", "clip", "square", "sqrt",
+    "maximum", "minimum", "mean", "max", "min", "log", "exp", "pow",
+    "softsign", "softplus", "stack", "concat", "expand_dims", "squeeze",
+    "contiguous", "mm", "batch_dot", "l2_normalize", "epsilon", "relu",
+    "sigmoid", "tanh", "slice", "index_select",
+]

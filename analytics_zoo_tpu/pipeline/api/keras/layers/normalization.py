@@ -52,8 +52,11 @@ class BatchNormalization(Layer):
         bshape[ch_axis] = inputs.shape[ch_axis]
 
         if training:
-            mean = jnp.mean(inputs, axis=reduce_axes)
-            var = jnp.var(inputs, axis=reduce_axes)
+            # statistics in f32 regardless of compute dtype (bf16 batch
+            # stats lose too much precision), normalize in compute dtype
+            x32 = inputs.astype(jnp.float32)
+            mean = jnp.mean(x32, axis=reduce_axes)
+            var = jnp.var(x32, axis=reduce_axes)
             m = self.momentum
             new_state = {
                 "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
@@ -63,10 +66,11 @@ class BatchNormalization(Layer):
             mean, var = state["moving_mean"], state["moving_var"]
             new_state = state
 
-        inv = params["gamma"].reshape(bshape) * (
-            1.0 / jnp.sqrt(var.reshape(bshape) + self.epsilon))
-        out = (inputs - mean.reshape(bshape)) * inv \
-            + params["beta"].reshape(bshape)
+        dt = inputs.dtype
+        inv = params["gamma"].astype(dt).reshape(bshape) * (
+            1.0 / jnp.sqrt(var.astype(dt).reshape(bshape) + self.epsilon))
+        out = (inputs - mean.astype(dt).reshape(bshape)) * inv \
+            + params["beta"].astype(dt).reshape(bshape)
         return out, new_state
 
     def call(self, params, state, inputs, training=False, rng=None):

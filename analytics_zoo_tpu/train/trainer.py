@@ -58,6 +58,45 @@ class TrainState:
         self.opt_state = tree["opt_state"]
 
 
+def build_train_step(model, loss_fn, optimizer, compute_dtype=None,
+                     jit: bool = True, donate: bool = True):
+    """THE training iteration: grad → (XLA-inserted psum when the batch is
+    sharded) → optax update, with optional bf16 mixed precision (bf16
+    compute, f32 master weights; grads return f32 through the cast's
+    transpose).  Single source of truth — the Trainer, bench.py and the
+    driver dry run all compile this same function.
+
+    Signature of the returned step:
+        (params, model_state, opt_state, rng, x, y)
+            -> (params, model_state, opt_state, loss)
+    """
+    cast = compute_dtype
+
+    def train_step(params, model_state, opt_state, rng, x, y):
+        def compute_loss(p):
+            xin, p_in = x, p
+            if cast is not None:
+                castf = lambda a: (a.astype(cast) if jnp.issubdtype(
+                    a.dtype, jnp.floating) else a)
+                xin = jax.tree_util.tree_map(castf, xin)
+                p_in = jax.tree_util.tree_map(castf, p_in)
+            y_pred, new_state = model.apply(
+                p_in, model_state, xin, training=True, rng=rng)
+            per_sample = loss_fn(y, y_pred.astype(jnp.float32)
+                                 if cast is not None else y_pred)
+            return jnp.mean(per_sample), new_state
+
+        (loss, new_state), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(params)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_state, new_opt_state, loss
+
+    if not jit:
+        return train_step
+    return jax.jit(train_step, donate_argnums=(0, 1, 2) if donate else ())
+
+
 class Trainer:
     def __init__(self, model, loss_fn: Callable, optimizer,
                  metrics: Sequence = (), mesh=None,
@@ -104,30 +143,8 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def _build_train_step(self):
-        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
-        cast = self.compute_dtype
-
-        def train_step(params, model_state, opt_state, rng, x, y):
-            def compute_loss(p):
-                xin = x
-                if cast is not None:
-                    xin = jax.tree_util.tree_map(
-                        lambda a: a.astype(cast)
-                        if jnp.issubdtype(a.dtype, jnp.floating) else a, xin)
-                y_pred, new_state = model.apply(
-                    p, model_state, xin, training=True, rng=rng)
-                per_sample = loss_fn(y, y_pred.astype(jnp.float32)
-                                     if cast is not None else y_pred)
-                return jnp.mean(per_sample), new_state
-
-            (loss, new_state), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(params)
-            updates, new_opt_state = optimizer.update(grads, opt_state,
-                                                      params)
-            new_params = optax.apply_updates(params, updates)
-            return new_params, new_state, new_opt_state, loss
-
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        return build_train_step(self.model, self.loss_fn, self.optimizer,
+                                compute_dtype=self.compute_dtype)
 
     def _build_eval_step(self):
         model, metrics = self.model, self.metrics

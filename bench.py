@@ -1,0 +1,73 @@
+"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+
+The north-star metric (BASELINE.md): images/sec/chip for ResNet-50 ImageNet
+through the framework's training path.  The reference publishes no absolute
+numbers (BASELINE.json "published": {}), so vs_baseline is reported against
+a fixed nominal target of 100 img/s/chip to give the driver a stable ratio.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from analytics_zoo_tpu.models.image.classification import resnet50
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+    from analytics_zoo_tpu.train.trainer import build_train_step
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    batch = 64 if on_tpu else 8
+    size = 224 if on_tpu else 64
+    steps = 20 if on_tpu else 3
+
+    model = resnet50(input_shape=(size, size, 3), num_classes=1000)
+    graph = model.to_graph()
+    params, state = graph.init(jax.random.PRNGKey(0))
+    optimizer = optax.sgd(0.1, momentum=0.9)
+    opt_state = optimizer.init(params)
+    loss_fn = objectives.get("sparse_categorical_crossentropy")
+
+    # the framework's own training iteration, bf16 mixed precision
+    jitted = build_train_step(graph, loss_fn, optimizer,
+                              compute_dtype=jnp.bfloat16)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, size, size, 3)),
+                    dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, batch), dtype=jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    # warmup / compile
+    params, state, opt_state, loss = jitted(params, state, opt_state, key,
+                                            x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.time()
+    for _ in range(steps):
+        params, state, opt_state, loss = jitted(params, state, opt_state,
+                                                key, x, y)
+    jax.block_until_ready(loss)
+    elapsed = time.time() - t0
+
+    # build_train_step is a single-device jit here; exactly one chip
+    # participates regardless of how many are visible
+    images_per_sec = batch * steps / elapsed
+    baseline = 100.0  # nominal target (no published reference number)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(images_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

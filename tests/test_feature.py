@@ -1,0 +1,139 @@
+"""Feature-engineering tests: Preprocessing chains, image + 3D transforms,
+ImageSet, and the predict_image_set path."""
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.feature.common import (
+    ChainedPreprocessing, FeatureLabelPreprocessing, ScalarToTensor,
+    SeqToTensor, preprocessing_from_spec, preprocessing_to_spec)
+from analytics_zoo_tpu.feature.image import (
+    ImageChannelNormalize, ImageChannelOrder, ImageCenterCrop, ImageHFlip,
+    ImageMatToTensor, ImageResize, ImageSet, ImageSetToSample)
+from analytics_zoo_tpu.feature.image3d import (
+    CenterCrop3D, Crop3D, RandomCrop3D, Rotate3D, rotation_matrix)
+
+
+def test_chain_composition_and_adapters():
+    chain = SeqToTensor((2, 2)) >> SeqToTensor((4,))
+    out = chain.apply([1, 2, 3, 4])
+    assert out.shape == (4,)
+
+    flp = FeatureLabelPreprocessing(SeqToTensor((2,)), ScalarToTensor())
+    f, l = flp.apply(([3.0, 4.0], 7))
+    np.testing.assert_allclose(f, [3, 4])
+    np.testing.assert_allclose(l, [7])
+
+    # config round-trip (needed for ML-pipeline persistence)
+    spec = preprocessing_to_spec(chain)
+    chain2 = preprocessing_from_spec(spec)
+    np.testing.assert_allclose(chain2.apply([1, 2, 3, 4]), out)
+
+
+def test_image_transform_chain():
+    rng = np.random.default_rng(0)
+    img = rng.uniform(0, 255, (40, 60, 3)).astype(np.float32)
+    chain = ChainedPreprocessing([
+        ImageResize(32, 32),
+        ImageCenterCrop(24, 24),
+        ImageChannelNormalize(mean_r=123, mean_g=117, mean_b=104),
+        ImageMatToTensor(),
+        ImageSetToSample(),
+    ])
+    x, y = chain.apply(img)
+    assert x.shape == (24, 24, 3)
+    assert y is None
+
+
+def test_image_flip_and_channel_order():
+    img = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    flipped = ImageHFlip(probability=1.0).transform(img)
+    np.testing.assert_allclose(flipped, img[:, ::-1])
+    swapped = ImageChannelOrder().transform(img)
+    np.testing.assert_allclose(swapped, img[:, :, ::-1])
+
+
+def test_imageset_read_with_labels(tmp_path):
+    from PIL import Image
+    for cls_name, color in [("cats", (255, 0, 0)), ("dogs", (0, 0, 255))]:
+        d = tmp_path / cls_name
+        d.mkdir()
+        for i in range(3):
+            Image.new("RGB", (16, 12), color).save(d / f"img{i}.jpg")
+    iset = ImageSet.read(str(tmp_path), with_label=True)
+    assert len(iset) == 6
+    labels = iset.labels()
+    assert sorted(np.unique(labels).tolist()) == [1, 2]
+    arr = iset.to_array()
+    assert arr.shape == (6, 12, 16, 3)
+    # red image in BGR: channel 2 should be 255
+    red = [f for f in iset.features if "cats" in f["uri"]][0]
+    assert red["image"][0, 0, 2] > 250  # jpeg-lossy red in BGR
+
+
+def test_imageset_to_dataset_and_predict_image_set():
+    zoo.init_nncontext()
+    from analytics_zoo_tpu.models import ImageClassifier
+    rng = np.random.default_rng(0)
+    imgs = rng.uniform(0, 1, (8, 32, 32, 3)).astype(np.float32)
+    iset = ImageSet.from_arrays(imgs)
+    iset.transform(ImageMatToTensor())
+    model = ImageClassifier(model_name="squeezenet",
+                            input_shape=(32, 32, 3), num_classes=5)
+    model.compile(optimizer="sgd", loss="categorical_crossentropy")
+    result = model.predict_image_set(iset)
+    preds = result.get_predicts()
+    assert len(preds) == 8
+    assert preds[0][1].shape == (5,)
+
+
+def test_rotation_matrix_orthonormal():
+    m = rotation_matrix(0.3, -0.2, 1.0)
+    np.testing.assert_allclose(m @ m.T, np.eye(3), atol=1e-10)
+    assert np.linalg.det(m) == pytest.approx(1.0)
+
+
+def test_rotate3d_identity_and_90deg():
+    vol = np.random.default_rng(0).normal(size=(8, 8, 8)).astype(np.float32)
+    ident = Rotate3D((0, 0, 0)).transform(vol)
+    np.testing.assert_allclose(ident, vol, atol=1e-5)
+    # 90° yaw rotation is a permutation of axes (up to interpolation):
+    # rotating twice by 180° returns the original
+    r180 = Rotate3D((np.pi, 0, 0))
+    twice = r180.transform(r180.transform(vol))
+    np.testing.assert_allclose(twice, vol, atol=1e-3)
+
+
+def test_crop3d_variants():
+    vol = np.arange(6 * 6 * 6, dtype=np.float32).reshape(6, 6, 6)
+    out = Crop3D((1, 2, 3), (2, 2, 2)).transform(vol)
+    np.testing.assert_allclose(out, vol[1:3, 2:4, 3:5])
+    out = CenterCrop3D((4, 4, 4)).transform(vol)
+    np.testing.assert_allclose(out, vol[1:5, 1:5, 1:5])
+    out = RandomCrop3D((3, 3, 3), seed=1).transform(vol)
+    assert out.shape == (3, 3, 3)
+
+
+def test_wide_and_deep_save_load(tmp_path):
+    """Regression: WideAndDeep persistence round-trip (was broken — config
+    lost column_info)."""
+    zoo.init_nncontext()
+    from analytics_zoo_tpu.models import ColumnFeatureInfo, WideAndDeep
+    ci = ColumnFeatureInfo(wide_base_dims=(4,), wide_cross_dims=(),
+                           indicator_dims=(3,), embed_in_dims=(5,),
+                           embed_out_dims=(2,), continuous_cols=("c1",))
+    wnd = WideAndDeep(model_type="wide_n_deep", num_classes=2,
+                      column_info=ci, hidden_layers=(8,))
+    wnd.compile(optimizer="adam", loss="mse")
+    rng = np.random.default_rng(0)
+    wide_x = rng.integers(1, 5, (16, 1)).astype(np.int32)
+    deep_x = np.concatenate([
+        rng.integers(0, 2, (16, 3)), rng.integers(1, 6, (16, 1)),
+        rng.normal(size=(16, 1))], axis=1).astype(np.float32)
+    ref = wnd.predict((wide_x, deep_x), batch_size=16)
+    wnd.save_model(str(tmp_path / "wnd"))
+    from analytics_zoo_tpu.pipeline.api.keras import load_model
+    loaded = load_model(str(tmp_path / "wnd"))
+    out = loaded.predict((wide_x, deep_x), batch_size=16)
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,167 @@
+"""Model zoo tests: TextClassifier, NeuralCF, WideAndDeep, ImageClassifier.
+
+Mirrors the reference's model specs (NeuralCFSpec/WideAndDeepSpec/
+TextClassifierSpec train briefly on synthetic data — SURVEY §4).
+"""
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.models import (
+    ColumnFeatureInfo, ImageClassifier, NeuralCF, TextClassifier,
+    UserItemFeature, WideAndDeep)
+
+
+def test_text_classifier_cnn_trains():
+    zoo.init_nncontext()
+    model = TextClassifier(class_num=3, token_length=16, sequence_length=24,
+                           encoder="cnn", encoder_output_dim=32)
+    model.compile(optimizer={"name": "adam", "lr": 5e-3},
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 3, 256).astype(np.int32)
+    x = rng.normal(0, 0.1, (256, 24, 16)).astype(np.float32)
+    for i in range(256):
+        x[i, :, y[i] * 5:y[i] * 5 + 3] += 1.0  # class-dependent channels
+    hist = model.fit(x, y, batch_size=32, nb_epoch=4)
+    res = model.evaluate(x, y, batch_size=32)
+    assert res["accuracy"] > 0.8, res
+
+
+@pytest.mark.parametrize("encoder", ["lstm", "gru"])
+def test_text_classifier_rnn_builds(encoder):
+    zoo.init_nncontext()
+    model = TextClassifier(class_num=2, token_length=8, sequence_length=12,
+                           encoder=encoder, encoder_output_dim=16)
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    x = np.random.randn(16, 12, 8).astype(np.float32)
+    probs = model.predict(x, batch_size=8)
+    assert probs.shape == (16, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_text_classifier_bad_encoder():
+    with pytest.raises(ValueError, match="Unsupported encoder"):
+        TextClassifier(class_num=2, token_length=8, sequence_length=12,
+                       encoder="transformer").to_graph()
+
+
+def test_neuralcf_trains_and_recommends():
+    zoo.init_nncontext()
+    n_users, n_items = 30, 40
+    rng = np.random.default_rng(0)
+    users = rng.integers(1, n_users + 1, 512)
+    items = rng.integers(1, n_items + 1, 512)
+    # deterministic preference: like iff (user+item) even
+    labels = ((users + items) % 2).astype(np.int32)
+    x = np.stack([users, items], axis=1).astype(np.int32)
+
+    model = NeuralCF(user_count=n_users, item_count=n_items, num_classes=2,
+                     user_embed=8, item_embed=8, hidden_layers=(16, 8),
+                     mf_embed=8)
+    # log-softmax output pairs with NLL == sparse CE on log-probs
+    import jax.numpy as jnp
+
+    def nll(y_true, y_pred):
+        labels_ = jnp.squeeze(y_true).astype(jnp.int32)
+        return -jnp.take_along_axis(y_pred, labels_[:, None],
+                                    axis=-1).squeeze(-1)
+
+    model.compile(optimizer={"name": "adam", "lr": 5e-3}, loss=nll,
+                  metrics=["accuracy"])
+    model.fit(x, labels, batch_size=64, nb_epoch=12)
+    res = model.evaluate(x, labels, batch_size=64)
+    assert res["accuracy"] > 0.85, res
+
+    pairs = [UserItemFeature(int(u), int(i), np.array([u, i],
+                                                     dtype=np.int32))
+             for u, i in zip(users[:64], items[:64])]
+    preds = model.predict_user_item_pair(pairs)
+    assert len(preds) == 64
+    assert all(p.prediction in (1, 2) for p in preds)
+    assert all(0 <= p.probability <= 1 for p in preds)
+    recs = model.recommend_for_user(pairs, max_items=3)
+    by_user = {}
+    for r in recs:
+        by_user.setdefault(r.user_id, []).append(r.probability)
+    for probs in by_user.values():
+        assert len(probs) <= 3
+        assert probs == sorted(probs, reverse=True)
+
+
+def test_wide_and_deep_variants():
+    zoo.init_nncontext()
+    ci = ColumnFeatureInfo(
+        wide_base_dims=(5, 7), wide_cross_dims=(9,),
+        indicator_dims=(4,), embed_in_dims=(10, 6), embed_out_dims=(4, 3),
+        continuous_cols=("age",))
+    rng = np.random.default_rng(0)
+    n = 128
+    wide_x = np.stack([
+        rng.integers(1, 6, n), 5 + rng.integers(1, 8, n),
+        12 + rng.integers(1, 10, n)], axis=1).astype(np.int32)
+    indicator = rng.integers(0, 2, (n, 4)).astype(np.float32)
+    embed_ids = np.stack([rng.integers(1, 11, n),
+                          rng.integers(1, 7, n)], axis=1)
+    cont = rng.normal(size=(n, 1))
+    deep_x = np.concatenate([indicator, embed_ids, cont],
+                            axis=1).astype(np.float32)
+    y = rng.integers(0, 2, n).astype(np.int32)
+
+    import jax.numpy as jnp
+
+    def nll(y_true, y_pred):
+        lbl = jnp.squeeze(y_true).astype(jnp.int32)
+        return -jnp.take_along_axis(y_pred, lbl[:, None], -1).squeeze(-1)
+
+    wnd = WideAndDeep(model_type="wide_n_deep", num_classes=2,
+                      column_info=ci, hidden_layers=(16, 8))
+    wnd.compile(optimizer="adam", loss=nll, metrics=["accuracy"])
+    wnd.fit((wide_x, deep_x), y, batch_size=32, nb_epoch=2)
+    out = wnd.predict((wide_x, deep_x), batch_size=32)
+    assert out.shape == (n, 2)
+    np.testing.assert_allclose(np.exp(out).sum(axis=1), 1.0, rtol=1e-4)
+
+    wide_only = WideAndDeep(model_type="wide", num_classes=2,
+                            column_info=ci)
+    wide_only.compile(optimizer="adam", loss=nll)
+    out = wide_only.predict(wide_x, batch_size=32)
+    assert out.shape == (n, 2)
+
+    deep_only = WideAndDeep(model_type="deep", num_classes=2,
+                            column_info=ci, hidden_layers=(16, 8))
+    deep_only.compile(optimizer="adam", loss=nll)
+    out = deep_only.predict(deep_x, batch_size=32)
+    assert out.shape == (n, 2)
+
+
+def test_resnet50_shapes_and_small_forward():
+    zoo.init_nncontext()
+    # full-size graph builds with correct output shape
+    model = ImageClassifier(model_name="resnet-50")
+    assert model.to_graph().output_shapes[0] == (None, 1000)
+    # small variant actually runs forward
+    small = ImageClassifier(model_name="resnet-50",
+                            input_shape=(32, 32, 3), num_classes=7)
+    small.compile(optimizer="sgd", loss="categorical_crossentropy")
+    x = np.random.randn(8, 32, 32, 3).astype(np.float32)
+    probs = small.predict(x, batch_size=8)
+    assert probs.shape == (8, 7)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_zoo_model_save_load(tmp_path):
+    zoo.init_nncontext()
+    model = NeuralCF(user_count=5, item_count=5, num_classes=2,
+                     user_embed=4, item_embed=4, hidden_layers=(8,),
+                     include_mf=False)
+    model.compile(optimizer="adam", loss="mse")
+    x = np.random.default_rng(0).integers(1, 6, (32, 2)).astype(np.int32)
+    ref = model.predict(x, batch_size=32)
+    model.save_model(str(tmp_path / "ncf"))
+    from analytics_zoo_tpu.pipeline.api.keras import load_model
+    loaded = load_model(str(tmp_path / "ncf"))
+    out = loaded.predict(x, batch_size=32)
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
