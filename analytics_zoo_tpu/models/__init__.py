@@ -4,3 +4,5 @@ from .recommendation import (Recommender, NeuralCF, WideAndDeep,
                              UserItemFeature, UserItemPrediction,
                              ColumnFeatureInfo)
 from .image.classification import ImageClassifier, resnet50, label_output
+from .image.detection import (ObjectDetector, ssd_vgg16, ssd_mobilenet,
+                              decode_output, ScaleDetection, visualize)

@@ -1,3 +1,5 @@
 from .classification import (ImageClassifier, resnet50, vgg16, vgg19,
                              mobilenet, mobilenet_v2, squeezenet,
                              inception_v1, densenet161, label_output)
+from .detection import (ObjectDetector, ssd_vgg16, ssd_mobilenet,
+                        decode_output, ScaleDetection, visualize)

@@ -1,1 +1,3 @@
 from . import elementwise
+from .attention import (attention, naive_attention, blockwise_attention,
+                        flash_attention)

@@ -1,0 +1,205 @@
+"""Attention ops: naive, blockwise (online-softmax), and a pallas TPU
+flash-attention kernel, plus a MultiHeadAttention layer.
+
+The reference has NO attention anywhere (SURVEY §5: "attention does not
+exist in the layer set") — this is the TPU-era extension the task brief
+makes first-class (long-context support).  Three implementations share one
+semantics:
+
+* ``naive_attention`` — O(S²) materialized scores; the test oracle.
+* ``blockwise_attention`` — lax.scan over key blocks with online softmax
+  (running max/denominator), O(S) memory; works on any backend and is the
+  building block ring attention reuses per-shard.
+* ``flash_attention`` — pallas TPU kernel: grid over (batch·heads,
+  q-blocks), VMEM-resident q/k/v blocks, online softmax in f32 accumulators
+  feeding the MXU per block pair.
+
+All take (batch, seq, heads, head_dim) and return the same shape.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def naive_attention(q, k, v, causal: bool = False, scale: float = None):
+    """Materialized-scores attention (oracle)."""
+    b, sq, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def blockwise_attention(q, k, v, causal: bool = False,
+                        block_k: int = 512, scale: float = None):
+    """Online-softmax attention scanning key blocks: O(seq) memory."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_k = min(block_k, sk)
+    if sk % block_k != 0:
+        raise ValueError(
+            f"block_k ({block_k}) must divide the key length ({sk})")
+    n_blocks = sk // block_k
+    kb = k.reshape(b, n_blocks, block_k, h, d)
+    vb = v.reshape(b, n_blocks, block_k, h, d)
+    q_scaled = q * scale
+    q_pos = jnp.arange(sq)
+
+    def body(carry, blk):
+        m_prev, l_prev, o_prev = carry
+        k_blk, v_blk, blk_idx = blk
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q_scaled, k_blk)
+        if causal:
+            k_pos = blk_idx * block_k + jnp.arange(block_k)
+            mask = q_pos[:, None] + (sk - sq) >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        m_blk = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(scores - m_new[..., None])
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1)
+        o_new = (o_prev * correction[..., None]
+                 + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk))
+        return (m_new, l_new, o_new), None
+
+    m0 = jnp.full((b, h, sq), NEG_INF)
+    l0 = jnp.zeros((b, h, sq))
+    o0 = jnp.zeros((b, h, sq, d))
+    (m, l, o), _ = lax.scan(
+        body, (m0, l0, o0),
+        (jnp.swapaxes(kb, 0, 1), jnp.swapaxes(vb, 0, 1),
+         jnp.arange(n_blocks)))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.swapaxes(out, 1, 2)  # (b, h, q, d) -> (b, q, h, d)
+
+
+# ------------------------------------------------------------ pallas kernel
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sk: int,
+                  causal: bool, sq: int, scale: float):
+    """One (batch·head, q-block) cell: iterate key blocks in VMEM with
+    online softmax; accumulators stay f32 for stability."""
+    q = q_ref[...].astype(jnp.float32) * scale  # (block_q, d)
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    n_kblocks = sk // block_k
+
+    def body(j, carry):
+        m_prev, l_prev, o_prev = carry
+        k_blk = k_ref[pl.dslice(j * block_k, block_k), :].astype(
+            jnp.float32)
+        v_blk = v_ref[pl.dslice(j * block_k, block_k), :].astype(
+            jnp.float32)
+        scores = q @ k_blk.T  # (block_q, block_k) on the MXU
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + (sk - sq)
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        m_blk = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(scores - m_new[:, None])
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1)
+        o_new = o_prev * corr[:, None] + p @ v_blk
+        return m_new, l_new, o_new
+
+    d = q.shape[-1]
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        # skip key blocks strictly after this q block's last position
+        last_q = (qi + 1) * block_q - 1 + (sk - sq)
+        n_iter = jnp.minimum(last_q // block_k + 1, n_kblocks)
+    else:
+        n_iter = n_kblocks
+    m, l, o = lax.fori_loop(0, n_iter, body, (m0, l0, o0))
+    o_ref[...] = (o / jnp.maximum(l[:, None], 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, scale: float = None,
+                    interpret: bool = False):
+    """Pallas TPU flash attention; same layout contract as the others.
+
+    ``interpret=True`` runs the kernel in the pallas interpreter (CPU
+    testing — SURVEY §4's "local device = cluster" trick applied to
+    kernels).
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lengths ({sq}, {sk}) must divide blocks "
+            f"({block_q}, {block_k})")
+    # fold batch and heads into the grid's first axis
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k, sk=sk,
+                               causal=causal, sq=sq, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _largest_divisor(n: int, cap: int) -> int:
+    """Largest divisor of n that is <= cap."""
+    for d in range(min(cap, n), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def attention(q, k, v, causal: bool = False, implementation: str = "auto"):
+    """Dispatch: pallas on TPU, blockwise elsewhere; awkward sequence
+    lengths (no usable block divisor) fall back to naive."""
+    sq, sk = q.shape[1], k.shape[1]
+    if implementation == "auto":
+        bq, bk = _largest_divisor(sq, 128), _largest_divisor(sk, 128)
+        if min(bq, bk) < 8:
+            # prime-ish lengths: blocked kernels degenerate, use naive
+            return naive_attention(q, k, v, causal=causal)
+        if jax.devices()[0].platform == "tpu":
+            return flash_attention(q, k, v, causal=causal, block_q=bq,
+                                   block_k=bk)
+        return blockwise_attention(q, k, v, causal=causal, block_k=bk)
+    if implementation == "flash":
+        return flash_attention(q, k, v, causal=causal)
+    if implementation == "blockwise":
+        return blockwise_attention(q, k, v, causal=causal)
+    if implementation == "naive":
+        return naive_attention(q, k, v, causal=causal)
+    raise ValueError(f"Unknown implementation {implementation!r}")

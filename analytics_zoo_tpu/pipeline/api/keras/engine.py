@@ -119,7 +119,10 @@ class KerasNet(Layer):
         return self.trainer.evaluate(ds, batch_size)
 
     def predict(self, x, batch_size: int = 32, distributed: bool = True):
-        self._require_compiled()
+        if self.trainer is None:
+            # inference needs no user compile (reference predict works on
+            # a bare loaded model); attach a default trainer lazily
+            self.compile(optimizer="sgd", loss="mse")
         return self.trainer.predict(x, batch_size)
 
     def predict_classes(self, x, batch_size: int = 32,

@@ -1,0 +1,111 @@
+"""Net loaders + GraphNet: model import and transfer-learning surgery.
+
+Parity surface: reference zoo/.../pipeline/api/Net.scala:89-189 (load /
+load_bigdl / load_caffe / load_torch / load_tf / load_keras) and GraphNet
+(pyzoo/zoo/pipeline/api/net.py:43-108: new_graph, freeze_up_to, unfreeze,
+to_keras; scala trait NetUtils.scala:216-277).
+
+Import policy (SURVEY §7 non-goals + §2.9): the framework's own format
+loads natively; TF interop is replaced by jax-native functions served via
+``InferenceModel.load_jax`` (there is no embedded TF runtime to port —
+TFNet's JNI session was the thing being replaced); Caffe/Torch-legacy
+formats are dead and raise with guidance.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ...core.graph import GraphModule, Variable
+from .keras.engine import KerasNet, Model
+
+
+class Net:
+    """Static loaders (reference Net.scala:89-189)."""
+
+    @staticmethod
+    def load(path: str, weight_path: Optional[str] = None) -> KerasNet:
+        """Load a model saved by this framework (reference Net.load reads
+        the zoo/BigDL protobuf format)."""
+        net = KerasNet.load_model(path)
+        if weight_path is not None:
+            if net.trainer is None:
+                net.compile(optimizer="sgd", loss="mse")
+            net.trainer.ensure_initialized()
+            net.trainer.load_weights(weight_path)
+        return net
+
+    load_bigdl = load  # the native format IS this framework's format here
+
+    @staticmethod
+    def load_keras(json_path: Optional[str] = None,
+                   hdf5_path: Optional[str] = None):
+        raise NotImplementedError(
+            "Keras-1 HDF5 import is not supported in the TPU build; "
+            "define the model with analytics_zoo_tpu.pipeline.api.keras "
+            "(same layer surface) and load weights via checkpoints")
+
+    @staticmethod
+    def load_caffe(def_path: str, model_path: str):
+        raise NotImplementedError(
+            "Caffe model import is not supported in the TPU build "
+            "(format retired; reference kept it only for legacy zoo "
+            "weights)")
+
+    @staticmethod
+    def load_torch(path: str):
+        raise NotImplementedError(
+            "Torch7 .t7 import is not supported in the TPU build; for "
+            "pytorch interop convert weights to a checkpoint pytree")
+
+    @staticmethod
+    def load_tf(path: str):
+        raise NotImplementedError(
+            "Frozen-GraphDef import is replaced in the TPU build: wrap "
+            "the computation as a jax function and serve it with "
+            "InferenceModel.load_jax (the reference's TFNet existed to "
+            "embed a TF runtime, which this framework replaces outright)")
+
+
+class GraphNet(Model):
+    """Model + transfer-learning surgery (reference GraphNet)."""
+
+    @classmethod
+    def from_model(cls, model: Model) -> "GraphNet":
+        g = model.to_graph()
+        net = cls.__new__(cls)
+        KerasNet.__init__(net, name=model.name)
+        net._graph = g
+        net.inputs = g.input_vars
+        net.outputs = g.output_vars
+        return net
+
+    def nodes(self, names: Sequence[str]) -> List[Variable]:
+        by_name = {v.name: v for v in self._graph.nodes}
+        return [by_name[n] for n in names]
+
+    def freeze_up_to(self, names: Sequence[str]) -> "GraphNet":
+        """Freeze every layer from the inputs up to (inclusive) the named
+        nodes (reference freezeUpTo, NetUtils.scala:216-277): their
+        weights stop receiving gradients."""
+        targets = self.nodes(names)
+        frozen_ids = set()
+        for t in targets:
+            for v in t.ancestors():
+                frozen_ids.add(v.node_id)
+        for v in self._graph.nodes:
+            if v.node_id in frozen_ids and v.layer is not None:
+                v.layer.trainable = False
+        return self
+
+    def unfreeze(self) -> "GraphNet":
+        for layer in self._graph.layers:
+            layer.trainable = True
+        return self
+
+    def frozen_layer_names(self) -> List[str]:
+        return [l.name for l in self._graph.layers if not l.trainable]
+
+    def to_keras(self) -> Model:
+        """reference GraphNet.to_keras: it already IS a keras Model."""
+        return self

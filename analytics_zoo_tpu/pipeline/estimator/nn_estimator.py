@@ -1,0 +1,364 @@
+"""nnframes equivalent: ML-pipeline Estimator/Transformer over dataframes.
+
+Parity surface: reference zoo/.../pipeline/nnframes/{NNEstimator.scala
+(class :163, internalFit :359, getDataSet :330, params :44-143),
+NNClassifier.scala:42-140, NNImageReader.scala:146-179} and the python
+mirror pyzoo/zoo/pipeline/nnframes/nn_classifier.py.
+
+The reference rides Spark ML (Estimator/Transformer over DataFrames, fit
+drives the BigDL Optimizer).  Here the dataframe is pandas (the per-host
+data plane; a Spark adapter is a thin collect-to-host away, per SURVEY §7
+stage 8), fit drives the SPMD Trainer, and transform appends a prediction
+column.  Param names/setters mirror the reference so pipeline code ports
+1:1 (set_batch_size, set_max_epoch, set_learning_rate, set_optim_method,
+set_end_when, set_validation, set_checkpoint, set_tensorboard, clipping).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from ...data.dataset import Dataset
+from ...feature.common import (Preprocessing, SeqToTensor,
+                               preprocessing_from_spec,
+                               preprocessing_to_spec)
+from ...train import triggers as trigger_lib
+from ...train.trainer import Trainer
+from ..api.keras import metrics as metrics_lib
+from ..api.keras import objectives as objectives_lib
+from ..api.keras import optimizers as optimizers_lib
+
+
+class _Params:
+    """Shared fluent params (reference NNEstimator.scala:44-143)."""
+
+    def __init__(self):
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.end_when: Optional[trigger_lib.Trigger] = None
+        self.learning_rate = 1e-3
+        self.learning_rate_decay = 0.0
+        self.optim_method: Any = "sgd"
+        self.features_col = "features"
+        self.label_col = "label"
+        self.prediction_col = "prediction"
+        self.caching_sample = True
+        self.clip_norm: Optional[float] = None
+        self.clip_value: Optional[tuple] = None
+        self.validation: Optional[tuple] = None
+        self.checkpoint: Optional[tuple] = None
+        self.tensorboard: Optional[tuple] = None
+
+    # fluent setters, snake_case of the reference's
+    def set_batch_size(self, v):
+        self.batch_size = int(v)
+        return self
+
+    def set_max_epoch(self, v):
+        self.max_epoch = int(v)
+        return self
+
+    def set_end_when(self, trigger):
+        self.end_when = trigger
+        return self
+
+    def set_learning_rate(self, v):
+        self.learning_rate = float(v)
+        return self
+
+    def set_learning_rate_decay(self, v):
+        self.learning_rate_decay = float(v)
+        return self
+
+    def set_optim_method(self, v):
+        self.optim_method = v
+        return self
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    def set_label_col(self, v):
+        self.label_col = v
+        return self
+
+    def set_prediction_col(self, v):
+        self.prediction_col = v
+        return self
+
+    def set_caching_sample(self, v):
+        self.caching_sample = bool(v)
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, v):
+        self.clip_norm = float(v)
+        return self
+
+    def set_constant_gradient_clipping(self, lo, hi):
+        self.clip_value = (float(lo), float(hi))
+        return self
+
+    def set_validation(self, trigger, df, metrics, batch_size):
+        """Parity: setValidation(trigger, validationDF, vMethods, batch)."""
+        self.validation = (trigger, df, list(metrics), int(batch_size))
+        return self
+
+    def set_checkpoint(self, path, trigger=None, over_write=True):
+        self.checkpoint = (path, trigger or trigger_lib.EveryEpoch(),
+                           over_write)
+        return self
+
+    def set_tensorboard(self, log_dir, app_name):
+        self.tensorboard = (log_dir, app_name)
+        return self
+
+
+def _column_to_array(df, col) -> np.ndarray:
+    vals = df[col].tolist()
+    arrs = [np.atleast_1d(np.asarray(v, dtype=np.float32)) for v in vals]
+    return np.asarray(arrs)
+
+
+class NNEstimator(_Params):
+    """fit(df) -> NNModel (reference NNEstimator.scala:163,359)."""
+
+    def __init__(self, model, criterion,
+                 sample_preprocessing: Optional[Preprocessing] = None,
+                 feature_preprocessing: Optional[Preprocessing] = None,
+                 label_preprocessing: Optional[Preprocessing] = None):
+        super().__init__()
+        self.model = model
+        self.criterion = criterion
+        self.sample_preprocessing = sample_preprocessing
+        self.feature_preprocessing = feature_preprocessing
+        self.label_preprocessing = label_preprocessing
+        self.mesh = None
+        self.last_trainer: Optional[Trainer] = None
+
+    # ---- data path (getDataSet parity, NNEstimator.scala:330-357) ----
+    def _to_dataset(self, df) -> Dataset:
+        feats = _column_to_array(df, self.features_col)
+        labels = (_column_to_array(df, self.label_col)
+                  if self.label_col in df.columns else None)
+        if self.feature_preprocessing is not None:
+            feats = np.stack([
+                np.asarray(self.feature_preprocessing.apply(f),
+                           dtype=np.float32) for f in feats])
+        if labels is not None and self.label_preprocessing is not None:
+            labels = np.stack([
+                np.asarray(self.label_preprocessing.apply(l),
+                           dtype=np.float32) for l in labels])
+        if self.sample_preprocessing is not None:
+            pairs = [self.sample_preprocessing.apply(
+                (f, None if labels is None else labels[i]))
+                for i, f in enumerate(feats)]
+            feats = np.stack([p[0] for p in pairs])
+            if labels is not None:
+                labels = np.stack([p[1] for p in pairs])
+        return Dataset.from_ndarray(feats, labels)
+
+    def _build_trainer(self) -> Trainer:
+        spec = self.optim_method
+        if isinstance(spec, str):
+            spec = {"name": spec, "lr": self.learning_rate,
+                    "decay": self.learning_rate_decay}
+        opt = optimizers_lib.get(spec, clip_norm=self.clip_norm,
+                                 clip_value=self.clip_value)
+        loss_fn = objectives_lib.get(self.criterion)
+        graph = (self.model.to_graph() if hasattr(self.model, "to_graph")
+                 else self.model)
+        metric_objs = []
+        if self.validation:
+            metric_objs = [metrics_lib.get(m) for m in self.validation[2]]
+        trainer = Trainer(graph, loss_fn, opt, metrics=metric_objs,
+                          mesh=self.mesh)
+        if self.tensorboard:
+            trainer.set_tensorboard(*self.tensorboard)
+        if self.checkpoint:
+            path, trig, over_write = self.checkpoint
+            trainer.set_checkpoint(path, over_write, trigger=trig)
+        return trainer
+
+    def fit(self, df) -> "NNModel":
+        """internalFit parity (NNEstimator.scala:359-412)."""
+        ds = self._to_dataset(df)
+        trainer = self._build_trainer()
+        end = self.end_when or trigger_lib.MaxEpoch(self.max_epoch)
+        val_ds, val_trigger, val_bs = None, None, None
+        if self.validation:
+            val_trigger, val_df, _, val_bs = self.validation
+            val_ds = self._to_dataset(val_df)
+        trainer.fit(ds, self.batch_size, end_trigger=end,
+                    validation_data=val_ds, validation_trigger=val_trigger,
+                    validation_batch_size=val_bs)
+        self.last_trainer = trainer
+        model = NNModel(self.model, trainer=trainer,
+                        feature_preprocessing=self.feature_preprocessing,
+                        sample_preprocessing=self.sample_preprocessing)
+        model.set_features_col(self.features_col)
+        model.set_prediction_col(self.prediction_col)
+        model.set_batch_size(self.batch_size)
+        return model
+
+
+class NNModel(_Params):
+    """transform(df) appends predictions
+    (reference NNModel, NNEstimator.scala:527-587)."""
+
+    def __init__(self, model, trainer: Optional[Trainer] = None,
+                 feature_preprocessing: Optional[Preprocessing] = None,
+                 sample_preprocessing: Optional[Preprocessing] = None):
+        super().__init__()
+        self.model = model
+        self.feature_preprocessing = feature_preprocessing
+        self.sample_preprocessing = sample_preprocessing
+        if trainer is None:
+            graph = (model.to_graph() if hasattr(model, "to_graph")
+                     else model)
+            trainer = Trainer(graph, None, optimizers_lib.get("sgd"))
+        self.trainer = trainer
+
+    def _features(self, df) -> np.ndarray:
+        feats = _column_to_array(df, self.features_col)
+        if self.feature_preprocessing is not None:
+            feats = np.stack([
+                np.asarray(self.feature_preprocessing.apply(f),
+                           dtype=np.float32) for f in feats])
+        if self.sample_preprocessing is not None:
+            feats = np.stack([
+                np.asarray(self.sample_preprocessing.apply((f, None))[0],
+                           dtype=np.float32) for f in feats])
+        return feats
+
+    def transform(self, df):
+        feats = self._features(df)
+        preds = np.asarray(self.trainer.predict(feats, self.batch_size))
+        out = df.copy()
+        out[self.prediction_col] = [self._format_prediction(p)
+                                    for p in preds]
+        return out
+
+    def _format_prediction(self, p):
+        return p.tolist()
+
+    # ---- ML persistence (NNEstimator.scala:640-751) ----
+    def save(self, path: str, over_write: bool = True):
+        import json
+        os.makedirs(path, exist_ok=True)
+        meta = {
+            "class_name": type(self).__name__,
+            "model": {"class_name": type(self.model).__name__,
+                      "config": self.model.get_config()},
+            "feature_preprocessing":
+                None if self.feature_preprocessing is None else
+                preprocessing_to_spec(self.feature_preprocessing),
+            "sample_preprocessing":
+                None if self.sample_preprocessing is None else
+                preprocessing_to_spec(self.sample_preprocessing),
+            "features_col": self.features_col,
+            "prediction_col": self.prediction_col,
+            "batch_size": self.batch_size,
+        }
+        mpath = os.path.join(path, "nnmodel.json")
+        if os.path.exists(mpath) and not over_write:
+            raise FileExistsError(path)
+        with open(mpath, "w") as f:
+            json.dump(meta, f)
+        self.trainer.ensure_initialized()
+        # persist inference state only (params + model buffers): the
+        # optimizer state is training-run detail and would pin load() to
+        # the same optimizer type
+        import jax as _jax
+        from ...train.checkpoint import save_checkpoint
+        st = self.trainer.state
+        save_checkpoint(os.path.join(path, "weights"), "final",
+                        _jax.device_get({"params": st.params,
+                                         "model_state": st.model_state}))
+
+    @classmethod
+    def load(cls, path: str) -> "NNModel":
+        import json
+        from ..api.keras.engine import _MODEL_CLASSES
+        from ...core.module import get_layer_class
+        with open(os.path.join(path, "nnmodel.json")) as f:
+            meta = json.load(f)
+        mcls_name = meta["model"]["class_name"]
+        if mcls_name in _MODEL_CLASSES:
+            model = _MODEL_CLASSES[mcls_name].from_config(
+                meta["model"]["config"])
+        else:
+            model = get_layer_class(mcls_name).from_config(
+                meta["model"]["config"])
+        klass = NNClassifierModel if meta["class_name"] == \
+            "NNClassifierModel" else cls
+        obj = klass(
+            model,
+            feature_preprocessing=None
+            if meta["feature_preprocessing"] is None else
+            preprocessing_from_spec(meta["feature_preprocessing"]),
+            sample_preprocessing=None
+            if meta["sample_preprocessing"] is None else
+            preprocessing_from_spec(meta["sample_preprocessing"]))
+        obj.set_features_col(meta["features_col"])
+        obj.set_prediction_col(meta["prediction_col"])
+        obj.set_batch_size(meta["batch_size"])
+        obj.trainer.ensure_initialized()
+        import jax as _jax
+        from ...train.checkpoint import restore_checkpoint
+        st = obj.trainer.state
+        tree = restore_checkpoint(
+            os.path.join(path, "weights"),
+            {"params": _jax.device_get(st.params),
+             "model_state": _jax.device_get(st.model_state)})
+        st.params = _jax.device_put(tree["params"])
+        st.model_state = _jax.device_put(tree["model_state"])
+        return obj
+
+
+class NNClassifier(NNEstimator):
+    """Classification sugar: scalar zero-based labels, argmax transform
+    (reference NNClassifier.scala:42)."""
+
+    def fit(self, df) -> "NNClassifierModel":
+        nn_model = super().fit(df)
+        clf = NNClassifierModel(
+            self.model, trainer=nn_model.trainer,
+            feature_preprocessing=self.feature_preprocessing,
+            sample_preprocessing=self.sample_preprocessing)
+        clf.set_features_col(self.features_col)
+        clf.set_prediction_col(self.prediction_col)
+        clf.set_batch_size(self.batch_size)
+        return clf
+
+
+class NNClassifierModel(NNModel):
+    """Argmax over the network output (reference NNClassifier.scala:140)."""
+
+    def _format_prediction(self, p):
+        return float(np.argmax(p))
+
+
+def read_images(path: str, with_label: bool = False,
+                resize_h: Optional[int] = None,
+                resize_w: Optional[int] = None):
+    """NNImageReader parity (reference NNImageReader.scala:146-179): read
+    images into a pandas DataFrame with columns image(+label)."""
+    import pandas as pd
+    from ...feature.image import ImageSet, ImageResize
+    iset = ImageSet.read(path, with_label=with_label)
+    if resize_h and resize_w:
+        iset = iset.transform(ImageResize(resize_h, resize_w))
+    rows = {
+        "image": [f["image"] for f in iset.features],
+        "uri": [f.get("uri") for f in iset.features],
+    }
+    if with_label:
+        rows["label"] = [float(np.asarray(f["label"]).ravel()[0])
+                         for f in iset.features]
+    return pd.DataFrame(rows)
+
+
+NNImageReader = read_images

@@ -1,0 +1,1 @@
+from .inference_model import InferenceModel, AbstractInferenceModel, JTensor

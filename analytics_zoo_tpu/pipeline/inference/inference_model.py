@@ -1,0 +1,165 @@
+"""InferenceModel: the thread-safe serving handle.
+
+Parity surface: reference zoo/.../pipeline/inference/
+{AbstractInferenceModel.java:30-148, FloatInferenceModel.scala:29-83,
+InferenceModelFactory.scala, JTensor.java}.
+
+The reference clones the model N times behind a LinkedBlockingQueue because
+BigDL modules carry mutable forward state.  A jitted JAX function is pure
+and thread-safe over immutable device arrays, so ONE compiled executable
+serves all threads; ``supported_concurrent_num`` is honored with a
+semaphore purely to bound concurrent device work (queueing semantics match
+the reference's blocking take/offer).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+import jax
+
+
+class JTensor:
+    """Plain data+shape carrier (reference JTensor.java) — accepted and
+    returned for POJO-style callers; numpy works everywhere too."""
+
+    def __init__(self, data, shape=None):
+        arr = np.asarray(data, dtype=np.float32)
+        self.data = arr.ravel()
+        self.shape = tuple(shape) if shape is not None else arr.shape
+
+    def to_ndarray(self) -> np.ndarray:
+        return self.data.reshape(self.shape)
+
+    @classmethod
+    def from_ndarray(cls, arr) -> "JTensor":
+        return cls(arr)
+
+
+def _to_ndarray(x):
+    if isinstance(x, JTensor):
+        return x.to_ndarray()
+    return np.asarray(x, dtype=np.float32)
+
+
+class InferenceModel:
+    """load / predict with bounded concurrency
+    (reference AbstractInferenceModel API)."""
+
+    def __init__(self, supported_concurrent_num: int = 1):
+        self.concurrent_num = int(supported_concurrent_num)
+        self._semaphore = threading.Semaphore(self.concurrent_num)
+        self._predict_fn = None
+        self._params = None
+        self._state = None
+        self._graph = None
+
+    # ---- loading (reference load/loadCaffe/loadTF surface) ----
+    def load(self, model_path: str, weight_path: Optional[str] = None):
+        """Load a model saved with save_model (the framework's own
+        format; reference ``load`` reads BigDL format)."""
+        from ..api.keras.engine import KerasNet
+        net = KerasNet.load_model(model_path)
+        if net.trainer is None:
+            net.compile(optimizer="sgd", loss="mse")
+        net.trainer.ensure_initialized()
+        if weight_path is not None:
+            net.trainer.load_weights(weight_path)
+        self._attach(net.to_graph(), net.trainer.state.params,
+                     net.trainer.state.model_state)
+        return self
+
+    def load_keras_net(self, net):
+        """Serve an in-memory KerasNet/ZooModel."""
+        if net.trainer is None:
+            net.compile(optimizer="sgd", loss="mse")
+        net.trainer.ensure_initialized()
+        self._attach(net.to_graph(), net.trainer.state.params,
+                     net.trainer.state.model_state)
+        return self
+
+    def load_jax(self, fn, params):
+        """Serve a raw jax function fn(params, x) (the TFNet-equivalent
+        import path for externally-defined computations)."""
+        self._graph = None
+        self._params = jax.device_put(params)
+        self._state = None
+        jitted = jax.jit(fn)
+
+        def predict_fn(x):
+            return jitted(self._params, x)
+
+        self._predict_fn = predict_fn
+        return self
+
+    def _attach(self, graph, params, state):
+        self._graph = graph
+        self._params = params
+        self._state = state
+
+        @jax.jit
+        def forward(params, state, x):
+            out, _ = graph.apply(params, state, x, training=False)
+            return out
+
+        def predict_fn(x):
+            return forward(self._params, self._state, x)
+
+        self._predict_fn = predict_fn
+
+    def reload(self, model_path: str, weight_path: Optional[str] = None):
+        return self.load(model_path, weight_path)
+
+    # ---- prediction (AbstractInferenceModel.predict:112-126) ----
+    def predict(self, inputs) -> Any:
+        """Accepts one batch array, a JTensor, a list of per-sample inputs,
+        or a list of input-lists for multi-input models; returns
+        predictions in the matching container type."""
+        if self._predict_fn is None:
+            raise RuntimeError("InferenceModel: no model loaded")
+        batched, single, jtensor = self._normalize(inputs)
+        with self._semaphore:
+            out = self._predict_fn(batched)
+        out = np.asarray(jax.device_get(out))
+        if jtensor:
+            tensors = [JTensor.from_ndarray(o) for o in out]
+            return tensors[0] if single else tensors
+        return out[0] if single else out
+
+    def _normalize(self, inputs):
+        jtensor = False
+        single = False
+        if isinstance(inputs, JTensor):
+            inputs, jtensor, single = [inputs], True, True
+        if isinstance(inputs, np.ndarray):
+            return inputs, False, False
+        if isinstance(inputs, tuple):
+            # tuple = multi-input batch (one array per model input)
+            return tuple(np.asarray(a, dtype=np.float32) for a in inputs), \
+                False, False
+        if isinstance(inputs, list):
+            if inputs and isinstance(inputs[0], JTensor):
+                jtensor = True
+                arrs = [_to_ndarray(t) for t in inputs]
+                return np.stack(arrs), single, jtensor
+            if inputs and isinstance(inputs[0], (list, tuple)):
+                # list of per-sample input-lists (multi-input models):
+                # stack column-wise into one batch array per input
+                n_inputs = len(inputs[0])
+                return tuple(
+                    np.stack([_to_ndarray(sample[i]) for sample in inputs])
+                    for i in range(n_inputs)), single, jtensor
+            arrs = [_to_ndarray(t) for t in inputs]
+            return np.stack(arrs), single, jtensor
+        return np.asarray(inputs, dtype=np.float32), False, False
+
+    def __repr__(self):
+        loaded = self._predict_fn is not None
+        return (f"InferenceModel(concurrent={self.concurrent_num}, "
+                f"loaded={loaded})")
+
+
+class AbstractInferenceModel(InferenceModel):
+    """Name-parity alias for the POJO-style entry class."""

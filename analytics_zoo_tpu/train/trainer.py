@@ -173,15 +173,19 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def _put_batch(self, x, y):
-        put = partial(jax.device_put)
-        xs = (tuple(put(a, self._batch_sharding) for a in x)
-              if isinstance(x, (tuple, list))
-              else put(x, self._batch_sharding))
+        first = x[0] if isinstance(x, (tuple, list)) else x
+        dp = mesh_lib.dp_size(self.mesh)
+        # batches that don't divide the data axis (small predict calls)
+        # fall back to replicated placement instead of failing
+        sharding = (self._batch_sharding if len(first) % max(dp, 1) == 0
+                    else self._repl_sharding)
+        put = lambda a: jax.device_put(a, sharding)
+        xs = (tuple(put(a) for a in x) if isinstance(x, (tuple, list))
+              else put(x))
         if y is None:
             return xs, None
-        ys = (tuple(put(a, self._batch_sharding) for a in y)
-              if isinstance(y, (tuple, list))
-              else put(y, self._batch_sharding))
+        ys = (tuple(put(a) for a in y) if isinstance(y, (tuple, list))
+              else put(y))
         return xs, ys
 
     def set_tensorboard(self, log_dir: str, app_name: str):
@@ -202,8 +206,8 @@ class Trainer:
     # ------------------------------------------------------------------
     def fit(self, dataset: Dataset, batch_size: int, end_trigger=None,
             validation_data: Optional[Dataset] = None,
-            validation_trigger=None, shuffle: bool = True,
-            verbose: bool = False) -> Dict[str, List]:
+            validation_trigger=None, validation_batch_size: int = None,
+            shuffle: bool = True, verbose: bool = False) -> Dict[str, List]:
         """Run the optimization loop until ``end_trigger`` fires.
 
         Returns a history dict of per-iteration losses and validation
@@ -262,7 +266,8 @@ class Trainer:
                       f"({epoch_samples / elapsed:.0f} samples/s)")
             if validation_data is not None and validation_trigger(
                     epoch_record):
-                results = self.evaluate(validation_data, batch_size)
+                results = self.evaluate(validation_data,
+                                        validation_batch_size or batch_size)
                 history["val"].append({"epoch": st.epoch, **results})
                 if self.val_summary is not None:
                     for k, v in results.items():

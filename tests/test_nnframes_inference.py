@@ -1,0 +1,209 @@
+"""nnframes (NNEstimator/NNClassifier) + InferenceModel + GraphNet tests.
+
+Mirrors the reference's NNEstimatorSpec/NNClassifierSpec (fit/transform on
+a local dataframe) and the serving concurrency test shape (SURVEY §4).
+"""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.feature.common import SeqToTensor
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.api.net import GraphNet, Net
+from analytics_zoo_tpu.pipeline.estimator import (NNClassifier, NNEstimator,
+                                                  NNModel)
+from analytics_zoo_tpu.pipeline.inference import InferenceModel, JTensor
+from analytics_zoo_tpu.train.triggers import EveryEpoch
+
+
+def make_df(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    return pd.DataFrame({
+        "features": [row.tolist() for row in x],
+        "label": y.tolist(),
+    })
+
+
+def linear_model(out=2, activation="softmax"):
+    m = Sequential()
+    m.add(Dense(16, input_shape=(4,), activation="relu"))
+    m.add(Dense(out, activation=activation))
+    return m
+
+
+def test_nnestimator_fit_transform():
+    zoo.init_nncontext()
+    df = make_df()
+    est = (NNEstimator(linear_model(1, None), "mse",
+                       feature_preprocessing=SeqToTensor((4,)))
+           .set_batch_size(32).set_max_epoch(5)
+           .set_learning_rate(0.05).set_optim_method("adam"))
+    model = est.fit(df)
+    assert isinstance(model, NNModel)
+    out = model.transform(df)
+    assert "prediction" in out.columns
+    assert len(out) == len(df)
+    preds = np.asarray([p[0] for p in out["prediction"]])
+    labels = df["label"].to_numpy()
+    acc = np.mean((preds > 0.5) == (labels > 0.5))
+    assert acc > 0.8, acc
+
+
+def test_nnclassifier_argmax_and_validation(tmp_path):
+    zoo.init_nncontext()
+    df, val_df = make_df(128), make_df(64, seed=1)
+    clf = (NNClassifier(linear_model(2), "sparse_categorical_crossentropy",
+                        feature_preprocessing=SeqToTensor((4,)))
+           .set_batch_size(32).set_max_epoch(6)
+           .set_learning_rate(0.05).set_optim_method("adam")
+           .set_validation(EveryEpoch(), val_df, ["accuracy"], 32)
+           .set_tensorboard(str(tmp_path / "logs"), "clf"))
+    model = clf.fit(df)
+    out = model.transform(df)
+    preds = out["prediction"].to_numpy()
+    assert set(np.unique(preds)) <= {0.0, 1.0}
+    acc = np.mean(preds == df["label"].to_numpy())
+    assert acc > 0.8, acc
+    assert (tmp_path / "logs" / "clf" / "validation").exists()
+
+
+def test_nnmodel_save_load_roundtrip(tmp_path):
+    zoo.init_nncontext()
+    df = make_df(64)
+    est = (NNEstimator(linear_model(1, None), "mse",
+                       feature_preprocessing=SeqToTensor((4,)))
+           .set_batch_size(32).set_max_epoch(2))
+    model = est.fit(df)
+    ref = model.transform(df)["prediction"].tolist()
+    model.save(str(tmp_path / "m"))
+    loaded = NNModel.load(str(tmp_path / "m"))
+    out = loaded.transform(df)["prediction"].tolist()
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_inference_model_predict_and_concurrency(tmp_path):
+    zoo.init_nncontext()
+    net = linear_model(3)
+    net.compile(optimizer="sgd", loss="mse")
+    x = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    _ = net.predict(x, batch_size=16)
+    net.save_model(str(tmp_path / "served"))
+
+    im = InferenceModel(supported_concurrent_num=4)
+    im.load(str(tmp_path / "served"))
+    out = im.predict(x)
+    assert out.shape == (16, 3)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+    # JTensor POJO-style call
+    jt_out = im.predict([JTensor(x[0]), JTensor(x[1])])
+    assert isinstance(jt_out[0], JTensor)
+    np.testing.assert_allclose(jt_out[0].to_ndarray(), out[0], rtol=1e-5)
+
+    # concurrent predictions from many threads are consistent
+    results = [None] * 8
+    def worker(i):
+        results[i] = im.predict(x)
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    for r in results:
+        np.testing.assert_allclose(r, out, rtol=1e-5)
+
+
+def test_inference_model_load_jax():
+    import jax.numpy as jnp
+    im = InferenceModel()
+    params = {"w": np.eye(4, dtype=np.float32) * 2.0}
+    im.load_jax(lambda p, x: x @ p["w"], params)
+    x = np.ones((2, 4), dtype=np.float32)
+    np.testing.assert_allclose(im.predict(x), 2 * x)
+
+
+def test_inference_model_errors():
+    im = InferenceModel()
+    with pytest.raises(RuntimeError, match="no model loaded"):
+        im.predict(np.zeros((1, 2)))
+    with pytest.raises(NotImplementedError, match="TFNet|load_jax"):
+        Net.load_tf("/nonexistent")
+    with pytest.raises(NotImplementedError):
+        Net.load_caffe("a", "b")
+
+
+def test_graphnet_freeze_up_to():
+    zoo.init_nncontext()
+    from analytics_zoo_tpu.core.graph import Input
+    from analytics_zoo_tpu.pipeline.api.keras import Model
+    x = Input((4,), name="gin")
+    h1 = Dense(8, name="frozen_dense")(x)
+    h2 = Dense(2, name="head_dense")(h1)
+    net = GraphNet.from_model(Model(input=x, output=h2))
+    net.freeze_up_to(["frozen_dense"])
+    assert net.frozen_layer_names() == ["frozen_dense"]
+    net.compile(optimizer={"name": "sgd", "lr": 0.5}, loss="mse")
+    xv = np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32)
+    yv = np.random.default_rng(1).normal(size=(64, 2)).astype(np.float32)
+    before = {k: np.asarray(v["W"]).copy()
+              for k, v in net.get_weights().items()}
+    net.fit(xv, yv, batch_size=32, nb_epoch=2)
+    after = net.get_weights()
+    np.testing.assert_allclose(after["frozen_dense"]["W"],
+                               before["frozen_dense"])  # frozen
+    assert not np.allclose(after["head_dense"]["W"],
+                           before["head_dense"])  # trained
+    net.unfreeze()
+    assert net.frozen_layer_names() == []
+
+
+def test_nnmodel_save_load_with_adam(tmp_path):
+    """Regression: load() used to rebuild with sgd and fail on the adam
+    checkpoint tree."""
+    zoo.init_nncontext()
+    df = make_df(64)
+    est = (NNEstimator(linear_model(1, None), "mse",
+                       feature_preprocessing=SeqToTensor((4,)))
+           .set_batch_size(32).set_max_epoch(2).set_optim_method("adam"))
+    model = est.fit(df)
+    ref = model.transform(df)["prediction"].tolist()
+    model.save(str(tmp_path / "adam_m"))
+    loaded = NNModel.load(str(tmp_path / "adam_m"))
+    out = loaded.transform(df)["prediction"].tolist()
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_inference_multi_input_model():
+    """Regression: list-of-input-lists and tuple-of-batches for
+    multi-input models."""
+    zoo.init_nncontext()
+    from analytics_zoo_tpu.core.graph import Input
+    from analytics_zoo_tpu.pipeline.api.keras import Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Merge
+    a, b = Input((3,), name="mi_a"), Input((5,), name="mi_b")
+    out = Dense(2)(Merge(mode="concat", concat_axis=-1)([a, b]))
+    net = Model(input=[a, b], output=out)
+    net.compile(optimizer="sgd", loss="mse")
+    im = InferenceModel().load_keras_net(net)
+    rng = np.random.default_rng(0)
+    xa = rng.normal(size=(4, 3)).astype(np.float32)
+    xb = rng.normal(size=(4, 5)).astype(np.float32)
+    batch_out = im.predict((xa, xb))
+    assert batch_out.shape == (4, 2)
+    listy = im.predict([[xa[i], xb[i]] for i in range(4)])
+    np.testing.assert_allclose(listy, batch_out, rtol=1e-5)
+
+
+def test_predict_without_compile():
+    """Regression: predict/predict_image_set on an uncompiled model."""
+    zoo.init_nncontext()
+    m = linear_model(2)
+    out = m.predict(np.zeros((4, 4), np.float32), batch_size=4)
+    assert out.shape == (4, 2)

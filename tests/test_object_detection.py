@@ -1,0 +1,115 @@
+"""Object detection tests: priors, decoding, NMS, ObjectDetector e2e."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.models.image.detection import (
+    ObjectDetector, ScaleDetection, decode_boxes, decode_output,
+    nms_padded, ssd_priors, ssd_vgg16, model_priors, visualize)
+
+
+def test_ssd300_prior_count_canonical():
+    priors = ssd_priors(300)
+    assert priors.shape == (8732, 4)  # the SSD-300 magic number
+    assert priors.min() >= 0 and priors.max() <= 1
+
+
+def test_ssd_vgg16_head_matches_priors():
+    model = ssd_vgg16(num_classes=21, image_size=300)
+    out_shape = model.to_graph().output_shapes[0]
+    priors = model_priors(model, 21, 300)
+    assert out_shape == (None, priors.shape[0], 25)
+
+
+def test_decode_boxes_zero_deltas_recover_priors():
+    priors = np.array([[0.5, 0.5, 0.2, 0.4]], np.float32)
+    boxes = np.asarray(decode_boxes(jnp.zeros((1, 4)), jnp.asarray(priors)))
+    np.testing.assert_allclose(boxes[0], [0.4, 0.3, 0.6, 0.7], atol=1e-6)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray([
+        [0.1, 0.1, 0.5, 0.5],
+        [0.12, 0.12, 0.52, 0.52],  # heavy overlap with 0
+        [0.6, 0.6, 0.9, 0.9],      # disjoint
+    ])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    idx, kept = nms_padded(boxes, scores, iou_threshold=0.5, max_out=3)
+    kept = np.asarray(kept)
+    idx = np.asarray(idx)
+    assert idx[0] == 0 and kept[0] == pytest.approx(0.9)
+    assert idx[1] == 2 and kept[1] == pytest.approx(0.7)
+    assert kept[2] < 0  # suppressed slot padded
+
+
+def test_decode_output_finds_planted_box():
+    """Plant one confident prior; decoding must return it on top."""
+    priors = ssd_priors(300)
+    n = priors.shape[0]
+    num_classes = 4
+    out = np.zeros((1, n, 4 + num_classes), np.float32)
+    out[:, :, 4] = 5.0  # background logits everywhere
+    target = 1234
+    out[0, target, 4] = 0.0
+    out[0, target, 4 + 2] = 8.0  # class 2 confident
+    dets = np.asarray(decode_output(
+        jnp.asarray(out), jnp.asarray(priors), num_classes,
+        conf_threshold=0.3, max_detections=10))
+    assert dets.shape == (1, 10, 6)
+    top = dets[0, 0]
+    assert top[0] == 2  # label
+    assert top[1] > 0.9  # score
+    cx, cy, w, h = priors[target]
+    np.testing.assert_allclose(top[2:], [cx - w / 2, cy - h / 2,
+                                         cx + w / 2, cy + h / 2], atol=1e-5)
+    # padding rows are -1-labelled
+    assert (dets[0, 1:, 0] == -1).all()
+
+
+def test_scale_detection_and_visualize():
+    dets = np.full((1, 2, 6), -1.0, np.float32)
+    dets[0, 0] = [1, 0.9, 0.1, 0.2, 0.5, 0.6]
+    scaled = ScaleDetection()(dets, heights=[100], widths=[200])
+    np.testing.assert_allclose(scaled[0, 0],
+                               [1, 0.9, 20, 20, 100, 60], atol=1e-4)
+    img = np.zeros((100, 200, 3), np.float32)
+    drawn = visualize(img, scaled[0], threshold=0.5)
+    assert drawn.shape == (100, 200, 3)
+    assert drawn.max() > 0  # something was drawn
+
+
+def test_object_detector_end_to_end_small():
+    zoo.init_nncontext()
+    from analytics_zoo_tpu.feature.image import ImageSet
+    det = ObjectDetector(model_name="ssd-vgg16-300", num_classes=4,
+                         conf_threshold=0.01, max_detections=5)
+    det.compile(optimizer="sgd", loss="mse")
+    rng = np.random.default_rng(0)
+    imgs = rng.uniform(0, 255, (2, 300, 300, 3)).astype(np.float32)
+    iset = ImageSet.from_arrays(imgs)
+    result = det.predict_image_set(iset, batch_size=2)
+    preds = result.get_predicts()
+    assert len(preds) == 2
+    assert preds[0][1].shape == (5, 6)
+    valid = preds[0][1][preds[0][1][:, 0] >= 0]
+    # untrained net: just plumbing guarantees — coords within image bounds
+    if len(valid):
+        assert valid[:, 2].min() >= 0 and valid[:, 4].max() <= 300
+
+
+def test_object_detector_unknown_name():
+    with pytest.raises(ValueError, match="frcnn|Unknown detector"):
+        ObjectDetector(model_name="frcnn-vgg16")
+
+
+def test_ssd_mobilenet_builds():
+    """Regression: ssd-mobilenet-300 used to crash at build (extra-layer
+    pyramid underflow)."""
+    from analytics_zoo_tpu.models.image.detection import (ssd_mobilenet,
+                                                          model_priors)
+    m = ssd_mobilenet(num_classes=21)
+    out_shape = m.to_graph().output_shapes[0]
+    priors = model_priors(m, 21, 300)
+    assert out_shape == (None, priors.shape[0], 25)
