@@ -41,6 +41,7 @@ class KerasNet(Layer):
     def __init__(self, name=None):
         super().__init__(name=name)
         self.trainer: Optional[Trainer] = None
+        self._inference_only = False
         self._compile_args: Optional[dict] = None
         self._tensorboard: Optional[tuple] = None
         self._checkpoint: Optional[tuple] = None
@@ -69,7 +70,20 @@ class KerasNet(Layer):
             self.trainer.set_checkpoint(*self._checkpoint)
         self._compile_args = {"optimizer": optimizer, "loss": loss,
                               "metrics": list(metrics)}
+        self._inference_only = False
         return self
+
+    def ensure_inference_ready(self) -> Trainer:
+        """Attach an inference-only trainer when the model was never
+        compiled (reference predict works on a bare loaded model).  Does
+        NOT satisfy _require_compiled — a later fit still demands a real
+        compile with the user's loss/optimizer."""
+        if self.trainer is None:
+            self.trainer = Trainer(self.to_graph(), None,
+                                   optimizers_lib.get("sgd"))
+            self._inference_only = True
+        self.trainer.ensure_initialized()
+        return self.trainer
 
     def set_tensorboard(self, log_dir: str, app_name: str):
         self._tensorboard = (log_dir, app_name)
@@ -91,7 +105,7 @@ class KerasNet(Layer):
         self._clip_value = (float(min_value), float(max_value))
 
     def _require_compiled(self):
-        if self.trainer is None:
+        if self.trainer is None or self._inference_only:
             raise RuntimeError(
                 "Model must be compiled before fit/evaluate "
                 "(reference requires compile before fit too)")
@@ -119,10 +133,7 @@ class KerasNet(Layer):
         return self.trainer.evaluate(ds, batch_size)
 
     def predict(self, x, batch_size: int = 32, distributed: bool = True):
-        if self.trainer is None:
-            # inference needs no user compile (reference predict works on
-            # a bare loaded model); attach a default trainer lazily
-            self.compile(optimizer="sgd", loss="mse")
+        self.ensure_inference_ready()
         return self.trainer.predict(x, batch_size)
 
     def predict_classes(self, x, batch_size: int = 32,
@@ -152,20 +163,21 @@ class KerasNet(Layer):
         cls = _MODEL_CLASSES[arch["class_name"]]
         model = cls.from_config(arch["config"])
         weights_dir = os.path.join(path, "weights")
-        if os.path.isdir(weights_dir) and model._compile_args is not None:
-            model.compile(**model._compile_args)
-            model.trainer.ensure_initialized()
+        if os.path.isdir(weights_dir):
+            if model._compile_args is not None:
+                model.compile(**model._compile_args)
+                model.trainer.ensure_initialized()
+            else:
+                model.ensure_inference_ready()
             model.trainer.load_weights(weights_dir)
         return model
 
     def get_weights(self):
-        self._require_compiled()
-        self.trainer.ensure_initialized()
+        self.ensure_inference_ready()
         return jax.device_get(self.trainer.state.params)
 
     def set_weights(self, params):
-        self._require_compiled()
-        self.trainer.ensure_initialized()
+        self.ensure_inference_ready()
         self.trainer.state.params = jax.device_put(params)
 
     # ---- summary (Topology.scala printNodeSummary parity) ----

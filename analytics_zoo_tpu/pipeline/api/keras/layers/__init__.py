@@ -21,5 +21,10 @@ from .advanced_activations import (ELU, LeakyReLU, PReLU, SReLU,
                                    ThresholdedReLU)
 from .noise import GaussianNoise, GaussianDropout
 from .recurrent import SimpleRNN, LSTM, GRU, ConvLSTM2D, Bidirectional
+from .torch_style import (
+    AddConstant, MulConstant, BinaryThreshold, Threshold, HardShrink,
+    SoftShrink, HardTanh, RReLU, Exp, Log, Sqrt, Square, Negative, Identity,
+    Power, Mul, CAdd, CMul, Scale, GaussianSampler, KerasLayerWrapper,
+    Narrow, Select, Squeeze)
 from ..engine import Sequential, Model
 from .....core.graph import Input, InputLayer

@@ -1,0 +1,503 @@
+"""Torch-style Keras-1 layers: elementwise math, thresholds, tensor surgery.
+
+Parity surface: reference zoo/.../pipeline/api/keras/layers/{AddConstant,
+BinaryThreshold, CAdd, CMul, Exp, GaussianSampler, HardShrink, HardTanh,
+Identity, KerasLayerWrapper, Log, Mul, MulConstant, Narrow, Negative, Power,
+RReLU, Select, SoftShrink, Sqrt, Square, Squeeze, Threshold, Scale}.scala
+(python mirror pyzoo/zoo/pipeline/api/keras/layers/torch.py).
+
+Dim conventions follow the reference exactly: ``dim``/``dims`` are 0-based
+indices over the FULL shape including the batch axis at 0; the batch axis may
+never be narrowed/selected/squeezed; for Narrow/Select ``-1`` means the last
+axis (Narrow.scala:47-55, Select.scala:50-60), while Squeeze requires
+non-negative dims as in the reference (Squeeze.scala:52-56 ``require(dim >=
+0)``).
+
+All of these are single fused XLA elementwise ops or static slices — they
+melt into neighbouring matmuls at compile time, so there is no per-layer
+kernel cost on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.module import Layer, register_layer
+
+
+class _Elementwise(Layer):
+    """Shared base for stateless identity-output-shape elementwise layers."""
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+@register_layer
+class AddConstant(_Elementwise):
+    """y = x + constant (reference AddConstant.scala:25-33)."""
+
+    def __init__(self, constant, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.constant = float(constant)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return inputs + self.constant
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["constant"] = self.constant
+        return cfg
+
+
+@register_layer
+class MulConstant(_Elementwise):
+    """y = x * constant (reference MulConstant.scala:25-33)."""
+
+    def __init__(self, constant, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.constant = float(constant)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return inputs * self.constant
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["constant"] = self.constant
+        return cfg
+
+
+@register_layer
+class BinaryThreshold(_Elementwise):
+    """y = 1 if x > value else 0 (reference BinaryThreshold.scala:25-33)."""
+
+    def __init__(self, value=1e-6, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.value = float(value)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return (inputs > self.value).astype(inputs.dtype)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["value"] = self.value
+        return cfg
+
+
+@register_layer
+class Threshold(_Elementwise):
+    """y = x if x > th else v (reference Threshold.scala:25-35)."""
+
+    def __init__(self, th=1e-6, v=0.0, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.th = float(th)
+        self.v = float(v)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.where(inputs > self.th, inputs, self.v)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(th=self.th, v=self.v)
+        return cfg
+
+
+@register_layer
+class HardShrink(_Elementwise):
+    """y = x if |x| > value else 0 (reference HardShrink.scala:25-33)."""
+
+    def __init__(self, value=0.5, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.value = float(value)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.where(jnp.abs(inputs) > self.value, inputs, 0.0)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["value"] = self.value
+        return cfg
+
+
+@register_layer
+class SoftShrink(_Elementwise):
+    """Shrink towards zero by value, zero inside the band
+    (reference SoftShrink.scala:25-33)."""
+
+    def __init__(self, value=0.5, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.value = float(value)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.where(
+            inputs > self.value, inputs - self.value,
+            jnp.where(inputs < -self.value, inputs + self.value, 0.0))
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["value"] = self.value
+        return cfg
+
+
+@register_layer
+class HardTanh(_Elementwise):
+    """Clip to [min_value, max_value] (reference HardTanh.scala:25-35)."""
+
+    def __init__(self, min_value=-1.0, max_value=1.0, input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        if max_value <= min_value:
+            raise ValueError("max_value must be > min_value")
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.clip(inputs, self.min_value, self.max_value)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(min_value=self.min_value, max_value=self.max_value)
+        return cfg
+
+
+@register_layer
+class RReLU(_Elementwise):
+    """Randomized leaky ReLU: negative slope ~ U[lower, upper] in training,
+    fixed mean slope at inference (reference RReLU.scala:25-34)."""
+
+    stochastic = True
+
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.lower = float(lower)
+        self.upper = float(upper)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        if training and rng is not None:
+            slope = jax.random.uniform(
+                rng, inputs.shape, minval=self.lower, maxval=self.upper)
+        else:
+            slope = (self.lower + self.upper) / 2.0
+        return jnp.where(inputs >= 0, inputs, inputs * slope)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(lower=self.lower, upper=self.upper)
+        return cfg
+
+
+@register_layer
+class Exp(_Elementwise):
+    """Reference Exp.scala:25-32."""
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.exp(inputs)
+
+
+@register_layer
+class Log(_Elementwise):
+    """Reference Log.scala:25-32."""
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.log(inputs)
+
+
+@register_layer
+class Sqrt(_Elementwise):
+    """Reference Sqrt.scala:25-32."""
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.sqrt(inputs)
+
+
+@register_layer
+class Square(_Elementwise):
+    """Reference Square.scala:25-32."""
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.square(inputs)
+
+
+@register_layer
+class Negative(_Elementwise):
+    """Reference Negative.scala:25-32."""
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return -inputs
+
+
+@register_layer
+class Identity(_Elementwise):
+    """Reference Identity.scala:25-30."""
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return inputs
+
+
+@register_layer
+class Power(_Elementwise):
+    """y = (shift + scale * x) ** power (reference Power.scala:25-35)."""
+
+    def __init__(self, power, scale=1.0, shift=0.0, input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.power = float(power)
+        self.scale = float(scale)
+        self.shift = float(shift)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.power(self.shift + self.scale * inputs, self.power)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(power=self.power, scale=self.scale, shift=self.shift)
+        return cfg
+
+
+@register_layer
+class Mul(_Elementwise):
+    """Learnable scalar multiply (reference Mul.scala:25-32)."""
+
+    def init_params(self, rng, input_shape):
+        return {"w": jnp.ones(())}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return inputs * params["w"]
+
+
+@register_layer
+class CAdd(_Elementwise):
+    """Learnable per-element bias of shape ``size``, broadcast against the
+    input (reference CAdd.scala:25-36).  ``size`` includes the batch axis
+    as in the reference (typically 1 there)."""
+
+    def __init__(self, size, b_regularizer=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = tuple(int(s) for s in size)
+        self.b_regularizer = b_regularizer
+
+    def init_params(self, rng, input_shape):
+        return {"b": jnp.zeros(self.size)}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return inputs + params["b"]
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["size"] = list(self.size)
+        return cfg
+
+
+@register_layer
+class CMul(_Elementwise):
+    """Learnable per-element scale of shape ``size``
+    (reference CMul.scala:25-36)."""
+
+    def __init__(self, size, w_regularizer=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = tuple(int(s) for s in size)
+        self.w_regularizer = w_regularizer
+
+    def init_params(self, rng, input_shape):
+        return {"w": jnp.ones(self.size)}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return inputs * params["w"]
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["size"] = list(self.size)
+        return cfg
+
+
+@register_layer
+class Scale(_Elementwise):
+    """CMul followed by CAdd with the same ``size``
+    (reference Scale.scala:25-40)."""
+
+    def __init__(self, size, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = tuple(int(s) for s in size)
+
+    def init_params(self, rng, input_shape):
+        return {"w": jnp.ones(self.size), "b": jnp.zeros(self.size)}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return inputs * params["w"] + params["b"]
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["size"] = list(self.size)
+        return cfg
+
+
+@register_layer
+class GaussianSampler(Layer):
+    """Sample from N(mean, exp(log_var)) given input [mean, log_var] — the
+    VAE reparameterization trick (reference GaussianSampler.scala:25-32).
+    Deterministic (returns the mean) when not training, so inference stays
+    reproducible under jit."""
+
+    stochastic = True
+
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        mean, log_var = inputs
+        if not training or rng is None:
+            return mean
+        eps = jax.random.normal(rng, mean.shape, dtype=mean.dtype)
+        return mean + jnp.exp(log_var * 0.5) * eps
+
+    def compute_output_shape(self, input_shape):
+        # input_shape is a list of two identical shapes
+        return tuple(input_shape[0])
+
+
+@register_layer
+class KerasLayerWrapper(Layer):
+    """Wrap an arbitrary function (or another Layer) as a Keras layer —
+    the reference wraps raw BigDL modules (KerasLayerWrapper.scala:25-31);
+    here the "torch layer" is any jax-traceable callable."""
+
+    def __init__(self, fn, output_shape=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.fn = fn
+        self._output_shape = output_shape
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return self.fn(inputs)
+
+    def compute_output_shape(self, input_shape):
+        if self._output_shape is not None:
+            return (input_shape[0],) + tuple(self._output_shape)
+        # graph shapes carry a None batch dim; substitute 1 for tracing
+        # and restore it in the result
+        concrete = tuple(1 if s is None else s for s in input_shape)
+        out = jax.eval_shape(
+            self.fn, jax.ShapeDtypeStruct(concrete, jnp.float32))
+        out_shape = tuple(out.shape)
+        if input_shape[0] is None:
+            out_shape = (None,) + out_shape[1:]
+        return out_shape
+
+    def get_config(self):
+        raise NotImplementedError(
+            "KerasLayerWrapper wraps an arbitrary python callable and "
+            "cannot be config-serialized; save weights instead")
+
+
+def _positive_dim(dim, ndim, layer):
+    positive = dim + ndim if dim < 0 else dim
+    if not 0 <= positive < ndim:
+        raise ValueError(f"{layer}: invalid dim {dim} for {ndim}D input")
+    if positive == 0:
+        raise ValueError(f"{layer}: cannot touch the batch dimension")
+    return positive
+
+
+@register_layer
+class Narrow(Layer):
+    """Static slice of ``length`` elements starting at ``offset`` along
+    ``dim`` (reference Narrow.scala:25-60; 0-based dims over the full
+    shape, batch untouchable, negative length means 'to the end')."""
+
+    def __init__(self, dim, offset, length=1, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim = int(dim)
+        self.offset = int(offset)
+        self.length = int(length)
+
+    def _resolve(self, full_shape):
+        d = _positive_dim(self.dim, len(full_shape), "Narrow")
+        size = full_shape[d]
+        length = self.length
+        if length < 0:
+            length = length + size - self.offset + 1
+        if not (0 <= self.offset and self.offset + length <= size):
+            raise ValueError(
+                f"Narrow: offset {self.offset} + length {length} out of "
+                f"range for axis size {size}")
+        return d, length
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        d, length = self._resolve(inputs.shape)
+        return jax.lax.slice_in_dim(inputs, self.offset,
+                                    self.offset + length, axis=d)
+
+    def compute_output_shape(self, input_shape):
+        d, length = self._resolve(input_shape)
+        out = list(input_shape)
+        out[d] = length
+        return tuple(out)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(dim=self.dim, offset=self.offset, length=self.length)
+        return cfg
+
+
+@register_layer
+class Select(Layer):
+    """Select one index along ``dim``, dropping the axis
+    (reference Select.scala:25-60)."""
+
+    def __init__(self, dim, index, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim = int(dim)
+        self.index = int(index)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        d = _positive_dim(self.dim, inputs.ndim, "Select")
+        idx = self.index + inputs.shape[d] if self.index < 0 else self.index
+        return jax.lax.index_in_dim(inputs, idx, axis=d, keepdims=False)
+
+    def compute_output_shape(self, input_shape):
+        d = _positive_dim(self.dim, len(input_shape), "Select")
+        return tuple(s for i, s in enumerate(input_shape) if i != d)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(dim=self.dim, index=self.index)
+        return cfg
+
+
+@register_layer
+class Squeeze(Layer):
+    """Drop singleton axes (all non-batch singletons when ``dims`` is None;
+    reference Squeeze.scala:25-60)."""
+
+    def __init__(self, dims=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        if dims is not None and not hasattr(dims, "__len__"):
+            dims = (dims,)
+        self.dims = tuple(int(d) for d in dims) if dims is not None else None
+        if self.dims is not None and any(d <= 0 for d in self.dims):
+            raise ValueError(
+                "Squeeze dims must be positive (0 is the batch axis)")
+
+    def _axes(self, full_shape):
+        if self.dims is None:
+            axes = tuple(i for i, s in enumerate(full_shape)
+                         if i > 0 and s == 1)
+        else:
+            for d in self.dims:
+                if full_shape[d] != 1:
+                    raise ValueError(
+                        f"Squeeze: axis {d} has size {full_shape[d]} != 1")
+            axes = self.dims
+        return axes
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        return jnp.squeeze(inputs, axis=self._axes(inputs.shape))
+
+    def compute_output_shape(self, input_shape):
+        axes = set(self._axes(tuple(input_shape)))
+        return tuple(s for i, s in enumerate(input_shape) if i not in axes)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["dims"] = list(self.dims) if self.dims is not None else None
+        return cfg

@@ -29,10 +29,7 @@ class Net:
         the zoo/BigDL protobuf format)."""
         net = KerasNet.load_model(path)
         if weight_path is not None:
-            if net.trainer is None:
-                net.compile(optimizer="sgd", loss="mse")
-            net.trainer.ensure_initialized()
-            net.trainer.load_weights(weight_path)
+            net.ensure_inference_ready().load_weights(weight_path)
         return net
 
     load_bigdl = load  # the native format IS this framework's format here

@@ -195,13 +195,18 @@ class NNEstimator(_Params):
                     validation_data=val_ds, validation_trigger=val_trigger,
                     validation_batch_size=val_bs)
         self.last_trainer = trainer
-        model = NNModel(self.model, trainer=trainer,
-                        feature_preprocessing=self.feature_preprocessing,
-                        sample_preprocessing=self.sample_preprocessing)
+        model = self._model_class()(
+            self.model, trainer=trainer,
+            feature_preprocessing=self.feature_preprocessing,
+            sample_preprocessing=self.sample_preprocessing)
         model.set_features_col(self.features_col)
         model.set_prediction_col(self.prediction_col)
         model.set_batch_size(self.batch_size)
         return model
+
+    def _model_class(self) -> type:
+        """Transformer class produced by fit; NNClassifier overrides."""
+        return NNModel
 
 
 class NNModel(_Params):
@@ -322,16 +327,8 @@ class NNClassifier(NNEstimator):
     """Classification sugar: scalar zero-based labels, argmax transform
     (reference NNClassifier.scala:42)."""
 
-    def fit(self, df) -> "NNClassifierModel":
-        nn_model = super().fit(df)
-        clf = NNClassifierModel(
-            self.model, trainer=nn_model.trainer,
-            feature_preprocessing=self.feature_preprocessing,
-            sample_preprocessing=self.sample_preprocessing)
-        clf.set_features_col(self.features_col)
-        clf.set_prediction_col(self.prediction_col)
-        clf.set_batch_size(self.batch_size)
-        return clf
+    def _model_class(self) -> type:
+        return NNClassifierModel
 
 
 class NNClassifierModel(NNModel):

@@ -62,22 +62,18 @@ class InferenceModel:
         format; reference ``load`` reads BigDL format)."""
         from ..api.keras.engine import KerasNet
         net = KerasNet.load_model(model_path)
-        if net.trainer is None:
-            net.compile(optimizer="sgd", loss="mse")
-        net.trainer.ensure_initialized()
+        trainer = net.ensure_inference_ready()
         if weight_path is not None:
-            net.trainer.load_weights(weight_path)
-        self._attach(net.to_graph(), net.trainer.state.params,
-                     net.trainer.state.model_state)
+            trainer.load_weights(weight_path)
+        self._attach(net.to_graph(), trainer.state.params,
+                     trainer.state.model_state)
         return self
 
     def load_keras_net(self, net):
         """Serve an in-memory KerasNet/ZooModel."""
-        if net.trainer is None:
-            net.compile(optimizer="sgd", loss="mse")
-        net.trainer.ensure_initialized()
-        self._attach(net.to_graph(), net.trainer.state.params,
-                     net.trainer.state.model_state)
+        trainer = net.ensure_inference_ready()
+        self._attach(net.to_graph(), trainer.state.params,
+                     trainer.state.model_state)
         return self
 
     def load_jax(self, fn, params):

@@ -1,0 +1,175 @@
+"""Torch-style layer forward semantics vs numpy.
+
+Mirrors the reference oracle-test pattern (SURVEY §4) with numpy as the
+oracle: each layer in torch_style.py is checked elementwise, tensor-surgery
+layers also for shape inference, and param layers for gradient flow.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from analytics_zoo_tpu.core.module import get_layer_class
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    AddConstant, MulConstant, BinaryThreshold, Threshold, HardShrink,
+    SoftShrink, HardTanh, RReLU, Exp, Log, Sqrt, Square, Negative, Identity,
+    Power, Mul, CAdd, CMul, Scale, GaussianSampler, KerasLayerWrapper,
+    Narrow, Select, Squeeze, Sequential, Dense)
+
+
+def apply_layer(layer, x, training=False, rng=None, input_shape=None):
+    if input_shape is None:
+        input_shape = x.shape
+    params, state = layer.init(jax.random.PRNGKey(0), input_shape)
+    out, _ = layer.apply(params, state, jnp.asarray(x), training=training,
+                         rng=rng)
+    assert tuple(out.shape) == layer.compute_output_shape(input_shape)
+    return np.asarray(out)
+
+
+X = np.random.default_rng(0).normal(size=(4, 5)).astype(np.float32)
+XPOS = np.abs(X) + 0.1
+
+
+@pytest.mark.parametrize("layer,x,expected", [
+    (AddConstant(2.5), X, X + 2.5),
+    (MulConstant(-3.0), X, X * -3.0),
+    (BinaryThreshold(0.1), X, (X > 0.1).astype(np.float32)),
+    (Threshold(0.2, -7.0), X, np.where(X > 0.2, X, -7.0)),
+    (HardShrink(0.5), X, np.where(np.abs(X) > 0.5, X, 0.0)),
+    (SoftShrink(0.5), X,
+     np.where(X > 0.5, X - 0.5, np.where(X < -0.5, X + 0.5, 0.0))),
+    (HardTanh(-0.3, 0.7), X, np.clip(X, -0.3, 0.7)),
+    (Exp(), X, np.exp(X)),
+    (Log(), XPOS, np.log(XPOS)),
+    (Sqrt(), XPOS, np.sqrt(XPOS)),
+    (Square(), X, np.square(X)),
+    (Negative(), X, -X),
+    (Identity(), X, X),
+    (Power(2.0, 2.0, 1.0), X, (1.0 + 2.0 * X) ** 2),
+])
+def test_elementwise_forward(layer, x, expected):
+    np.testing.assert_allclose(apply_layer(layer, x), expected,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rrelu_train_vs_eval():
+    x = X
+    out_eval = apply_layer(RReLU(0.1, 0.3), x)
+    slope = 0.2
+    np.testing.assert_allclose(out_eval, np.where(x >= 0, x, x * slope),
+                               rtol=1e-5, atol=1e-6)
+    out_train = apply_layer(RReLU(0.1, 0.3), x, training=True,
+                            rng=jax.random.PRNGKey(1))
+    neg = x < 0
+    ratio = out_train[neg] / x[neg]
+    assert ((ratio >= 0.1) & (ratio <= 0.3)).all()
+    np.testing.assert_allclose(out_train[~neg], x[~neg])
+
+
+def test_param_layers_forward_and_grad():
+    for layer, key, init_val in [(Mul(), "w", 1.0), (CAdd((1, 5)), "b", 0.0),
+                                 (CMul((1, 5)), "w", 1.0)]:
+        params, state = layer.init(jax.random.PRNGKey(0), X.shape)
+        np.testing.assert_allclose(np.asarray(params[key]),
+                                   np.full(params[key].shape, init_val))
+
+        def loss(p):
+            out, _ = layer.apply(p, state, jnp.asarray(X))
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(params)
+        assert np.abs(np.asarray(g[key])).sum() > 0
+
+    out, _ = Scale((1, 5)).apply(
+        *Scale((1, 5)).init(jax.random.PRNGKey(0), X.shape), jnp.asarray(X))
+    np.testing.assert_allclose(np.asarray(out), X, rtol=1e-6)
+
+
+def test_gaussian_sampler():
+    mean = np.zeros((8, 16), np.float32)
+    log_var = np.full((8, 16), -2.0, np.float32)
+    layer = GaussianSampler()
+    params, state = layer.init(jax.random.PRNGKey(0), [(8, 16), (8, 16)])
+    det, _ = layer.apply(params, state,
+                         [jnp.asarray(mean), jnp.asarray(log_var)])
+    np.testing.assert_allclose(np.asarray(det), mean)
+    samp, _ = layer.apply(params, state,
+                          [jnp.asarray(mean), jnp.asarray(log_var)],
+                          training=True, rng=jax.random.PRNGKey(3))
+    samp = np.asarray(samp)
+    assert samp.std() > 0
+    assert abs(samp.std() - np.exp(-1.0)) < 0.1
+
+
+def test_wrapper_layer():
+    layer = KerasLayerWrapper(lambda x: jnp.tanh(x) * 2.0)
+    np.testing.assert_allclose(apply_layer(layer, X), np.tanh(X) * 2.0,
+                               rtol=1e-5)
+
+
+def test_wrapper_layer_in_model():
+    # graph shapes carry a None batch dim — the eval_shape fallback must
+    # handle it (regression for review finding)
+    model = Sequential()
+    model.add(Dense(8, input_shape=(5,)))
+    model.add(KerasLayerWrapper(jnp.tanh))
+    out = model.predict(X, batch_size=4)
+    assert out.shape == (4, 8)
+
+
+def test_predict_does_not_satisfy_compile():
+    # lazy inference init must not let fit run with a default loss
+    model = Sequential()
+    model.add(Dense(8, input_shape=(5,)))
+    x = np.tile(X, (4, 1))
+    _ = model.predict(x, batch_size=8)
+    with pytest.raises(RuntimeError):
+        model.fit(x, np.zeros((16, 8), np.float32), batch_size=8, nb_epoch=1)
+    model.compile(optimizer="sgd", loss="mse")
+    model.fit(x, np.zeros((16, 8), np.float32), batch_size=8, nb_epoch=1,
+              verbose=0)
+
+
+def test_narrow_select_squeeze():
+    x = np.arange(2 * 3 * 4, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_allclose(apply_layer(Narrow(1, 1, 2), x), x[:, 1:3])
+    np.testing.assert_allclose(apply_layer(Narrow(2, 1, -1), x), x[:, :, 1:])
+    np.testing.assert_allclose(apply_layer(Select(1, 1), x), x[:, 1])
+    np.testing.assert_allclose(apply_layer(Select(-1, -1), x), x[:, :, -1])
+
+    y = np.zeros((2, 1, 3, 1), np.float32)
+    assert apply_layer(Squeeze(1), y).shape == (2, 3, 1)
+    assert apply_layer(Squeeze(), y).shape == (2, 3)
+
+    with pytest.raises(ValueError):
+        apply_layer(Select(0, 0), x)
+    with pytest.raises(ValueError):
+        Squeeze(0)
+    with pytest.raises(ValueError):
+        apply_layer(Squeeze(2), y)
+
+
+def test_config_roundtrip():
+    for layer in [AddConstant(1.5), Threshold(0.3, 1.0), HardTanh(-2, 2),
+                  Power(3.0, 0.5, 1.0), CAdd((1, 5)), Scale((1, 5)),
+                  Narrow(1, 2, 3), Select(1, 0), Squeeze((1, 2)),
+                  RReLU(0.1, 0.4)]:
+        cfg = layer.get_config()
+        cls = get_layer_class(type(layer).__name__)
+        clone = cls.from_config(cfg)
+        assert clone.get_config() == cfg
+
+
+def test_in_sequential_model():
+    model = Sequential()
+    model.add(Dense(8, input_shape=(5,)))
+    model.add(Threshold(0.0, 0.0))
+    model.add(Scale((1, 8)))
+    model.compile(optimizer="sgd", loss="mse")
+    x = np.random.default_rng(0).normal(size=(16, 5)).astype(np.float32)
+    y = np.random.default_rng(1).normal(size=(16, 8)).astype(np.float32)
+    model.fit(x, y, batch_size=8, nb_epoch=1, verbose=0)
+    out = model.predict(x, batch_size=8)
+    assert out.shape == (16, 8)
