@@ -36,12 +36,27 @@ _NAME_COUNTERS: "collections.Counter" = collections.Counter()
 
 
 def register_layer(cls):
-    """Class decorator: register for config-based (de)serialization."""
-    _LAYER_REGISTRY[cls.__name__] = cls
+    """Class decorator: register for config-based (de)serialization.
+
+    Layers whose class name collides with another registered layer (the
+    keras2 skin reuses keras1 names) set ``serial_name`` to register and
+    serialize under a distinct key."""
+    _LAYER_REGISTRY[getattr(cls, "serial_name", None) or cls.__name__] = cls
     return cls
 
 
+def serial_class_name(layer) -> str:
+    """Registry key a layer instance serializes under."""
+    return getattr(layer, "serial_name", None) or type(layer).__name__
+
+
 def get_layer_class(name: str) -> type:
+    if name not in _LAYER_REGISTRY and name.startswith("Keras2"):
+        # keras2 registers on import; a saved keras2 model must load even
+        # when the serving process never imported the keras2 package
+        import importlib
+
+        importlib.import_module("analytics_zoo_tpu.pipeline.api.keras2")
     if name not in _LAYER_REGISTRY:
         raise KeyError(
             f"Unknown layer class {name!r}; known: {sorted(_LAYER_REGISTRY)}"
@@ -66,6 +81,8 @@ class Layer:
     stochastic: bool = False
     #: set True on layers carrying non-trainable state (e.g. BatchNorm)
     stateful: bool = False
+    #: override when the class name collides with another registered layer
+    serial_name: Optional[str] = None
 
     def __init__(self, input_shape=None, name: Optional[str] = None, **kwargs):
         self.name = name or fresh_name(type(self).__name__.lower())

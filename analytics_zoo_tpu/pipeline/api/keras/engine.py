@@ -23,7 +23,8 @@ import numpy as np
 import jax
 
 from ....core.graph import GraphModule, Input, Variable
-from ....core.module import Layer, get_layer_class, register_layer
+from ....core.module import (Layer, get_layer_class, register_layer,
+                             serial_class_name)
 from ....data.dataset import Dataset
 from ....train import triggers as trigger_lib
 from ....train.trainer import Trainer
@@ -60,10 +61,17 @@ class KerasNet(Layer):
         opt = optimizers_lib.get(optimizer, clip_norm=self._clip_norm,
                                  clip_value=self._clip_value)
         metric_objs = [metrics_lib.get(m) for m in metrics]
+        prev_state = (self.trainer.state if self.trainer is not None
+                      else None)
         self.trainer = Trainer(self.to_graph(), loss_fn, opt,
                                metrics=metric_objs, mesh=mesh,
                                strategy=strategy, seed=seed,
                                compute_dtype=compute_dtype)
+        if prev_state is not None:
+            # weights loaded/set before compile (transfer learning) must
+            # survive the trainer swap
+            self.trainer.adopt_weights(prev_state.params,
+                                       prev_state.model_state)
         if self._tensorboard:
             self.trainer.set_tensorboard(*self._tensorboard)
         if self._checkpoint:
@@ -178,6 +186,13 @@ class KerasNet(Layer):
 
     def set_weights(self, params):
         self.ensure_inference_ready()
+        own = self.trainer.state.params
+        if (isinstance(params, dict) and isinstance(own, dict)
+                and set(params) != set(own) and len(params) == len(own)):
+            # weights from a structurally identical model whose layers got
+            # different auto-names: remap by position (the reference
+            # transfers weights positionally too)
+            params = {ok: params[pk] for ok, pk in zip(own, params)}
         self.trainer.state.params = jax.device_put(params)
 
     # ---- summary (Topology.scala printNodeSummary parity) ----
@@ -261,7 +276,7 @@ class Sequential(KerasNet):
     def get_config(self):
         return {
             "name": self.name,
-            "layers": [{"class_name": type(l).__name__,
+            "layers": [{"class_name": serial_class_name(l),
                         "config": l.get_config()} for l in self._layers],
             "compile_args": self._compile_args,
         }
@@ -308,7 +323,7 @@ class Model(KerasNet):
                 "id": v.node_id,
                 "name": v.name,
                 "layer": None if v.layer is None else {
-                    "class_name": type(v.layer).__name__,
+                    "class_name": serial_class_name(v.layer),
                     "config": v.layer.get_config()},
                 "inputs": [p.node_id for p in v.inputs],
                 "shape": [d for d in v.shape],

@@ -1,0 +1,8 @@
+from .layers import (
+    Dense, Activation, Dropout, Flatten, Conv1D, Conv2D, Cropping1D,
+    LocallyConnected1D, MaxPooling1D, AveragePooling1D,
+    GlobalMaxPooling1D, GlobalMaxPooling2D, GlobalMaxPooling3D,
+    GlobalAveragePooling1D, GlobalAveragePooling2D, GlobalAveragePooling3D,
+    Maximum, Minimum, Average, maximum, minimum, average)
+from ..keras.engine import Sequential, Model
+from ....core.graph import Input

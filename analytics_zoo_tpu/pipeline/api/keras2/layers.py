@@ -1,0 +1,239 @@
+"""Keras-2-style layer skin: keras-2 argument names over the keras-1 impls.
+
+Parity surface: reference zoo/.../pipeline/api/keras2/layers/*.scala and
+pyzoo/zoo/pipeline/api/keras2/layers/ — Dense(units=...), Conv1D/Conv2D
+(filters=..., kernel_size=..., strides=..., padding=...), pooling
+(pool_size/strides/padding), Dropout(rate=...), Cropping1D,
+LocallyConnected1D, and the Maximum/Minimum/Average merge layers with their
+functional helpers (merge.py:44,82,121).
+
+Each class subclasses the keras-1 implementation (the same structure the
+reference uses: keras2.Dense extends klayers1.Dense, Dense.scala:33-44) and
+re-emits get_config in keras-2 vocabulary.  ``serial_name`` disambiguates
+the registry entries from the keras-1 classes of the same name.
+"""
+
+from __future__ import annotations
+
+from ....core.module import Layer as _BaseLayer, register_layer
+from ..keras.layers import convolutional as k1conv
+from ..keras.layers import core as k1core
+from ..keras.layers import pooling as k1pool
+from ..keras.layers.merge import Merge as _K1Merge
+from ..keras.layers.pooling import (  # identical in both APIs; re-exported
+    GlobalMaxPooling1D, GlobalMaxPooling2D, GlobalMaxPooling3D,
+    GlobalAveragePooling1D, GlobalAveragePooling2D, GlobalAveragePooling3D)
+
+Activation = k1core.Activation  # same signature in keras-1 and keras-2
+Flatten = k1core.Flatten
+
+
+@register_layer
+class Dense(k1core.Dense):
+    """Reference keras2 Dense.scala:33-47 (units/kernel_initializer/
+    use_bias naming)."""
+
+    serial_name = "Keras2Dense"
+
+    def __init__(self, units, activation=None,
+                 kernel_initializer="glorot_uniform", use_bias=True,
+                 kernel_regularizer=None, bias_regularizer=None,
+                 input_shape=None, name=None):
+        super().__init__(output_dim=units, init=kernel_initializer,
+                         activation=activation, bias=use_bias,
+                         W_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer,
+                         input_shape=input_shape, name=name)
+
+    def get_config(self):
+        cfg = _BaseLayer.get_config(self)
+        cfg.update(units=self.output_dim, activation=self.activation_name,
+                   kernel_initializer=self.init_name, use_bias=self.bias)
+        return cfg
+
+
+@register_layer
+class Dropout(k1core.Dropout):
+    """Reference keras2 Dropout.scala (rate naming)."""
+
+    serial_name = "Keras2Dropout"
+
+    def __init__(self, rate, input_shape=None, name=None):
+        super().__init__(p=rate, input_shape=input_shape, name=name)
+
+    def get_config(self):
+        cfg = _BaseLayer.get_config(self)
+        cfg["rate"] = self.p
+        return cfg
+
+
+@register_layer
+class Conv1D(k1conv.Convolution1D):
+    """Reference keras2 Conv1D.scala:33-47."""
+
+    serial_name = "Keras2Conv1D"
+
+    def __init__(self, filters, kernel_size, strides=1, padding="valid",
+                 activation=None, use_bias=True,
+                 kernel_initializer="glorot_uniform",
+                 kernel_regularizer=None, bias_regularizer=None,
+                 input_shape=None, name=None):
+        super().__init__(nb_filter=filters, filter_length=kernel_size,
+                         init=kernel_initializer, activation=activation,
+                         border_mode=padding, subsample=strides,
+                         bias=use_bias, input_shape=input_shape, name=name)
+
+    def get_config(self):
+        cfg = _BaseLayer.get_config(self)
+        cfg.update(filters=self.nb_filter, kernel_size=self.kernel_size[0],
+                   strides=self.subsample[0], padding=self.border_mode,
+                   activation=self.activation_name, use_bias=self.bias,
+                   kernel_initializer=self.init_name)
+        return cfg
+
+
+@register_layer
+class Conv2D(k1conv.Convolution2D):
+    """Reference keras2 Conv2D.scala:34-49."""
+
+    serial_name = "Keras2Conv2D"
+
+    def __init__(self, filters, kernel_size, strides=(1, 1),
+                 padding="valid", activation=None, use_bias=True,
+                 kernel_initializer="glorot_uniform",
+                 kernel_regularizer=None, bias_regularizer=None,
+                 data_format=None, input_shape=None, name=None):
+        ks = (tuple(kernel_size) if hasattr(kernel_size, "__len__")
+              else (kernel_size, kernel_size))
+        super().__init__(nb_filter=filters, kernel_size=ks,
+                         init=kernel_initializer, activation=activation,
+                         border_mode=padding, subsample=strides,
+                         dim_ordering=data_format, bias=use_bias,
+                         input_shape=input_shape, name=name)
+
+    def get_config(self):
+        cfg = _BaseLayer.get_config(self)
+        cfg.update(filters=self.nb_filter,
+                   kernel_size=list(self.kernel_size),
+                   strides=list(self.subsample), padding=self.border_mode,
+                   activation=self.activation_name, use_bias=self.bias,
+                   kernel_initializer=self.init_name,
+                   data_format=self.data_format)
+        return cfg
+
+
+@register_layer
+class Cropping1D(k1conv.Cropping1D):
+    """Same semantics in both APIs (reference keras2 Cropping1D.scala)."""
+
+    serial_name = "Keras2Cropping1D"
+
+
+@register_layer
+class LocallyConnected1D(k1conv.LocallyConnected1D):
+    """Reference keras2 LocallyConnected1D.scala:31-44."""
+
+    serial_name = "Keras2LocallyConnected1D"
+
+    def __init__(self, filters, kernel_size, strides=1, padding="valid",
+                 activation=None, use_bias=True, kernel_regularizer=None,
+                 bias_regularizer=None, input_shape=None, name=None):
+        super().__init__(nb_filter=filters, filter_length=kernel_size,
+                         activation=activation, border_mode=padding,
+                         subsample_length=strides, bias=use_bias,
+                         input_shape=input_shape, name=name)
+
+    def get_config(self):
+        cfg = _BaseLayer.get_config(self)
+        cfg.update(filters=self.nb_filter, kernel_size=self.filter_length,
+                   strides=self.subsample, padding=self.border_mode,
+                   activation=self.activation_name, use_bias=self.bias)
+        return cfg
+
+
+@register_layer
+class MaxPooling1D(k1pool.MaxPooling1D):
+    """Reference keras2 MaxPooling1D.scala:31-40 (pool_size/strides)."""
+
+    serial_name = "Keras2MaxPooling1D"
+
+    def __init__(self, pool_size=2, strides=None, padding="valid",
+                 input_shape=None, name=None):
+        super().__init__(pool_length=pool_size, stride=strides,
+                         border_mode=padding, input_shape=input_shape,
+                         name=name)
+
+    def get_config(self):
+        cfg = _BaseLayer.get_config(self)
+        cfg.update(pool_size=self.pool_size[0], strides=self.strides[0],
+                   padding=self.border_mode)
+        return cfg
+
+
+@register_layer
+class AveragePooling1D(k1pool.AveragePooling1D):
+    """Reference keras2 AveragePooling1D.scala:31-40."""
+
+    serial_name = "Keras2AveragePooling1D"
+
+    def __init__(self, pool_size=2, strides=None, padding="valid",
+                 input_shape=None, name=None):
+        super().__init__(pool_length=pool_size, stride=strides,
+                         border_mode=padding, input_shape=input_shape,
+                         name=name)
+
+    def get_config(self):
+        cfg = _BaseLayer.get_config(self)
+        cfg.update(pool_size=self.pool_size[0], strides=self.strides[0],
+                   padding=self.border_mode)
+        return cfg
+
+
+class _FixedMerge(_K1Merge):
+    """Merge with the mode baked in (reference keras2 merge layers extend
+    Merge with a fixed mode, Maximum.scala:28-32)."""
+
+    merge_mode: str = None
+
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(layers=None, mode=self.merge_mode,
+                         input_shape=input_shape, name=name)
+
+    def get_config(self):
+        return _BaseLayer.get_config(self)
+
+
+@register_layer
+class Maximum(_FixedMerge):
+    """Elementwise max over inputs (reference keras2 Maximum.scala)."""
+
+    merge_mode = "max"
+
+
+@register_layer
+class Minimum(_FixedMerge):
+    """Elementwise min over inputs (reference keras2 Minimum.scala)."""
+
+    merge_mode = "min"
+
+
+@register_layer
+class Average(_FixedMerge):
+    """Elementwise mean over inputs (reference keras2 Average.scala)."""
+
+    merge_mode = "ave"
+
+
+def maximum(inputs, **kwargs):
+    """Functional helper (reference keras2 merge.py:44)."""
+    return Maximum(**kwargs)(list(inputs))
+
+
+def minimum(inputs, **kwargs):
+    """Functional helper (reference keras2 merge.py:82)."""
+    return Minimum(**kwargs)(list(inputs))
+
+
+def average(inputs, **kwargs):
+    """Functional helper (reference keras2 merge.py:121)."""
+    return Average(**kwargs)(list(inputs))

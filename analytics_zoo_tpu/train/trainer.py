@@ -141,6 +141,19 @@ class Trainer:
         self.state = TrainState(params, model_state, opt_state,
                                 rng=loop_rng)
 
+    def adopt_weights(self, params, model_state=None):
+        """Replace weights with an externally provided pytree (same
+        structure), re-placed under this trainer's shardings — used when
+        compile() supersedes an inference-only trainer so pre-loaded
+        weights survive."""
+        self.ensure_initialized()
+        self.state.params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, s), params,
+            self._param_shardings)
+        if model_state is not None:
+            self.state.model_state = jax.device_put(
+                model_state, self._repl_sharding)
+
     # ------------------------------------------------------------------
     def _build_train_step(self):
         return build_train_step(self.model, self.loss_fn, self.optimizer,
