@@ -48,6 +48,7 @@ class KerasNet(Layer):
         self._checkpoint: Optional[tuple] = None
         self._clip_norm = None
         self._clip_value = None
+        self._weights_loaded = False
 
     # ---- to be provided by subclasses ----
     def to_graph(self) -> GraphModule:
@@ -63,15 +64,23 @@ class KerasNet(Layer):
         metric_objs = [metrics_lib.get(m) for m in metrics]
         prev_state = (self.trainer.state if self.trainer is not None
                       else None)
+        # weights survive the trainer swap only when they carry meaning:
+        # explicitly loaded/set, or produced by a previous real compile.
+        # ensure_inference_ready's auto-init is NOT meaningful — adopting
+        # it would silently override this compile's seed.
+        meaningful = self._weights_loaded or not self._inference_only
         self.trainer = Trainer(self.to_graph(), loss_fn, opt,
                                metrics=metric_objs, mesh=mesh,
                                strategy=strategy, seed=seed,
                                compute_dtype=compute_dtype)
-        if prev_state is not None:
-            # weights loaded/set before compile (transfer learning) must
-            # survive the trainer swap
-            self.trainer.adopt_weights(prev_state.params,
-                                       prev_state.model_state)
+        if prev_state is not None and meaningful:
+            try:
+                self.trainer.adopt_weights(prev_state.params,
+                                           prev_state.model_state)
+            except ValueError:
+                # architecture changed since those weights were made
+                # (e.g. add() after fit): start from a fresh init
+                pass
         if self._tensorboard:
             self.trainer.set_tensorboard(*self._tensorboard)
         if self._checkpoint:
@@ -178,6 +187,7 @@ class KerasNet(Layer):
             else:
                 model.ensure_inference_ready()
             model.trainer.load_weights(weights_dir)
+            model._weights_loaded = True
         return model
 
     def get_weights(self):
@@ -191,9 +201,29 @@ class KerasNet(Layer):
                 and set(params) != set(own) and len(params) == len(own)):
             # weights from a structurally identical model whose layers got
             # different auto-names: remap by position (the reference
-            # transfers weights positionally too)
-            params = {ok: params[pk] for ok, pk in zip(own, params)}
+            # transfers weights positionally too) — but refuse silently
+            # mis-shaped assignments
+            remapped = {}
+            for ok, pk in zip(own, params):
+                own_shapes = jax.tree_util.tree_map(np.shape, own[ok])
+                new_shapes = jax.tree_util.tree_map(np.shape, params[pk])
+                if own_shapes != new_shapes:
+                    raise ValueError(
+                        f"set_weights: positional remap of {pk!r} onto "
+                        f"{ok!r} has mismatched shapes {new_shapes} vs "
+                        f"{own_shapes}")
+                remapped[ok] = params[pk]
+            params = remapped
         self.trainer.state.params = jax.device_put(params)
+        self._weights_loaded = True
+
+    def load_weights(self, directory: str, tag=None):
+        """Load checkpointed weights into the model (marks them as user
+        weights so a later compile preserves them)."""
+        self.ensure_inference_ready()
+        self.trainer.load_weights(directory, tag)
+        self._weights_loaded = True
+        return self
 
     # ---- summary (Topology.scala printNodeSummary parity) ----
     def summary(self) -> str:

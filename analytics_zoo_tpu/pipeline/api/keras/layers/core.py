@@ -383,8 +383,9 @@ class TimeDistributed(Layer):
         return (input_shape[0], input_shape[1]) + tuple(inner_out[1:])
 
     def get_config(self):
+        from .....core.module import serial_class_name
         cfg = super().get_config()
-        cfg["layer"] = {"class_name": type(self.layer).__name__,
+        cfg["layer"] = {"class_name": serial_class_name(self.layer),
                         "config": self.layer.get_config()}
         return cfg
 

@@ -292,9 +292,10 @@ class Bidirectional(Layer):
         return out
 
     def get_config(self):
+        from .....core.module import serial_class_name
         cfg = super().get_config()
         cfg["merge_mode"] = self.merge_mode
-        cfg["layer"] = {"class_name": type(self.layer).__name__,
+        cfg["layer"] = {"class_name": serial_class_name(self.layer),
                         "config": self.layer.get_config()}
         return cfg
 

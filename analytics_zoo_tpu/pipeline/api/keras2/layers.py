@@ -103,9 +103,7 @@ class Conv2D(k1conv.Convolution2D):
                  kernel_initializer="glorot_uniform",
                  kernel_regularizer=None, bias_regularizer=None,
                  data_format=None, input_shape=None, name=None):
-        ks = (tuple(kernel_size) if hasattr(kernel_size, "__len__")
-              else (kernel_size, kernel_size))
-        super().__init__(nb_filter=filters, kernel_size=ks,
+        super().__init__(nb_filter=filters, kernel_size=kernel_size,
                          init=kernel_initializer, activation=activation,
                          border_mode=padding, subsample=strides,
                          dim_ordering=data_format, bias=use_bias,
@@ -207,6 +205,7 @@ class _FixedMerge(_K1Merge):
 class Maximum(_FixedMerge):
     """Elementwise max over inputs (reference keras2 Maximum.scala)."""
 
+    serial_name = "Keras2Maximum"
     merge_mode = "max"
 
 
@@ -214,6 +213,7 @@ class Maximum(_FixedMerge):
 class Minimum(_FixedMerge):
     """Elementwise min over inputs (reference keras2 Minimum.scala)."""
 
+    serial_name = "Keras2Minimum"
     merge_mode = "min"
 
 
@@ -221,6 +221,7 @@ class Minimum(_FixedMerge):
 class Average(_FixedMerge):
     """Elementwise mean over inputs (reference keras2 Average.scala)."""
 
+    serial_name = "Keras2Average"
     merge_mode = "ave"
 
 

@@ -29,7 +29,7 @@ class Net:
         the zoo/BigDL protobuf format)."""
         net = KerasNet.load_model(path)
         if weight_path is not None:
-            net.ensure_inference_ready().load_weights(weight_path)
+            net.load_weights(weight_path)
         return net
 
     load_bigdl = load  # the native format IS this framework's format here

@@ -1,0 +1,24 @@
+"""TF interop: run user-written TensorFlow graphs on TPU via JAX.
+
+Parity surface (SURVEY.md §2.5, the north-star path): the reference ships a
+user TF graph to executors and drives it with the BigDL data-parallel
+optimizer (``TFDataset`` / ``TFOptimizer`` / ``TFPredictor``,
+pyzoo/zoo/pipeline/api/net.py:326-551; ``TFNet``
+zoo/.../pipeline/api/net/TFNet.scala:47-754 embeds a TF-Java session as a
+trainable module; ``export_tf`` pyzoo/zoo/util/tf.py:29-300 freezes graphs
+and generates backward graphs symbolically).
+
+The TPU-native design *replaces the embedded TF runtime entirely*: a frozen
+GraphDef is converted, op by op, into a pure JAX function
+(:mod:`.converter`), so the user's TF graph compiles into the same XLA SPMD
+step function as native models — gradients come from ``jax.grad`` (the
+reference's export-time ``tf.gradients`` machinery and its
+grads-smuggled-through-forward-outputs protocol, TFTrainingHelper.scala:81-120,
+disappear), and data parallelism is sharded-batch ``psum`` over ICI instead
+of Spark shuffle AllReduce.
+"""
+
+from .converter import ConvertedGraph, convert_graph_def  # noqa: F401
+from .dataset import TFDataset  # noqa: F401
+from .net import TFNet, export_tf  # noqa: F401
+from .optimizer import TFOptimizer, TFPredictor  # noqa: F401

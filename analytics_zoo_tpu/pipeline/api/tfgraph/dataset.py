@@ -1,0 +1,149 @@
+"""TFDataset: the distributed input-pipeline handle for TF-graph training.
+
+Parity surface: reference ``TFDataset`` (pyzoo/zoo/pipeline/api/net.py:432-509)
+wraps an RDD of ndarray lists, creates TF placeholders shaped
+``[None] + shape`` (or ``batch_size / total_core_num`` when hard-coded),
+registers itself in a TF collection keyed by placeholder name so
+``TFOptimizer`` can find it, and enforces ``batch_size % total cores == 0``.
+
+TPU translation: the "RDD" is any host iterable/ndarray; "total cores" is
+the data-parallel device count of the mesh (the per-device batch invariant
+on a pod is the same invariant, SURVEY §5); registration uses a TF graph
+collection exactly like the reference so graph-walking discovery works.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ....data.dataset import Dataset, check_batch_divisibility
+from ....parallel import mesh as mesh_lib
+
+_COLLECTION = "analytics_zoo_tpu_tfdataset"
+
+
+class TFDataset:
+    """Input pipeline feeding a user-written TF graph trained on TPU."""
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int = -1,
+                 batch_per_core: int = -1, has_label: bool = True,
+                 val_arrays: Optional[Sequence[np.ndarray]] = None):
+        if (batch_size > 0) == (batch_per_core > 0):
+            raise ValueError(
+                "set exactly one of batch_size (global, training) or "
+                "batch_per_core (inference)")
+        n_cores = max(mesh_lib.dp_size(mesh_lib.get_default_mesh()), 1)
+        if batch_size > 0:
+            check_batch_divisibility(batch_size, n_cores)
+            self.batch_size = batch_size
+        else:
+            self.batch_size = batch_per_core * n_cores
+        self.has_label = has_label
+        self.arrays = [np.asarray(a) for a in arrays]
+        self.val_arrays = ([np.asarray(a) for a in val_arrays]
+                           if val_arrays is not None else None)
+        self._placeholders: Optional[List[Any]] = None
+        if has_label:
+            x = tuple(self.arrays[:-1])
+            y = self.arrays[-1]
+            self.inner = Dataset(x if len(x) > 1 else x[0], y)
+        else:
+            x = tuple(self.arrays)
+            self.inner = Dataset(x if len(x) > 1 else x[0])
+        if self.val_arrays is not None:
+            if has_label:
+                vx = tuple(self.val_arrays[:-1])
+                self.val_inner: Optional[Dataset] = Dataset(
+                    vx if len(vx) > 1 else vx[0], self.val_arrays[-1])
+            else:
+                vx = tuple(self.val_arrays)
+                self.val_inner = Dataset(vx if len(vx) > 1 else vx[0])
+        else:
+            self.val_inner = None
+
+    # -- constructors (reference from_rdd :496 / from_ndarray) ----------
+    @classmethod
+    def from_ndarray(cls, tensors, batch_size: int = -1,
+                     batch_per_core: int = -1, has_label: bool = True,
+                     val_tensors=None) -> "TFDataset":
+        if isinstance(tensors, np.ndarray):
+            tensors = [tensors]
+        return cls(list(tensors), batch_size, batch_per_core, has_label,
+                   val_arrays=val_tensors)
+
+    @classmethod
+    def from_rdd(cls, rdd, names=None, shapes=None, types=None,
+                 batch_size: int = -1, batch_per_core: int = -1,
+                 has_label: bool = True, val_rdd=None) -> "TFDataset":
+        """Reference from_rdd: here an 'rdd' is any iterable of
+        ndarray-lists (one element per sample)."""
+        samples = [s if isinstance(s, (list, tuple)) else [s]
+                   for s in rdd]
+        arrays = [np.stack([np.asarray(s[i]) for s in samples])
+                  for i in range(len(samples[0]))]
+        val_arrays = None
+        if val_rdd is not None:
+            vs = [s if isinstance(s, (list, tuple)) else [s]
+                  for s in val_rdd]
+            val_arrays = [np.stack([np.asarray(s[i]) for s in vs])
+                          for i in range(len(vs[0]))]
+        return cls(arrays, batch_size, batch_per_core, has_label,
+                   val_arrays=val_arrays)
+
+    # -- TF-graph side --------------------------------------------------
+    @property
+    def tensors(self) -> List[Any]:
+        """Per-slot ``tf.placeholder`` list, shaped [None]+shape, created
+        in the current default graph and registered for discovery
+        (reference net.py:449-471)."""
+        import tensorflow as tf
+
+        if self._placeholders is None:
+            g = tf.compat.v1.get_default_graph()
+            phs = []
+            for i, a in enumerate(self.arrays):
+                ph = tf.compat.v1.placeholder(
+                    tf.dtypes.as_dtype(a.dtype), [None] + list(a.shape[1:]),
+                    name=f"zoo_tpu_input_{i}")
+                g.add_to_collection(_COLLECTION, (ph.op.name, i, self))
+                phs.append(ph)
+            self._placeholders = phs
+        return self._placeholders
+
+    @property
+    def feature_tensors(self) -> List[Any]:
+        return self.tensors[:-1] if self.has_label else self.tensors
+
+    @property
+    def label_tensor(self):
+        if not self.has_label:
+            raise ValueError("dataset built with has_label=False")
+        return self.tensors[-1]
+
+    def get_num_partitions(self) -> int:
+        return max(mesh_lib.dp_size(mesh_lib.get_default_mesh()), 1)
+
+
+def find_dataset(graph, placeholder_names: Sequence[str]) -> Tuple[
+        "TFDataset", List[int]]:
+    """Locate the registered TFDataset behind discovered placeholders and
+    return it plus each placeholder's slot index (reference
+    _find_placeholders, net.py:271-305)."""
+    registry = {name: (idx, ds)
+                for name, idx, ds in graph.get_collection(_COLLECTION)}
+    datasets = set()
+    slots = []
+    for name in placeholder_names:
+        if name not in registry:
+            raise ValueError(
+                f"placeholder {name!r} feeds the loss but was not created "
+                "by a TFDataset (use dataset.tensors as model inputs)")
+        idx, ds = registry[name]
+        slots.append(idx)
+        datasets.add(id(ds))
+        dataset = ds
+    if len(datasets) != 1:
+        raise ValueError("loss depends on more than one TFDataset")
+    return dataset, slots

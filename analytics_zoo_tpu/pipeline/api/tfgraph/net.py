@@ -1,0 +1,190 @@
+"""TFNet: a frozen TF graph as a framework Layer + export_tf.
+
+Parity surface: reference ``TFNet`` (zoo/.../api/net/TFNet.scala:47-754) is a
+BigDL module embedding a TF-Java session — forward marshals tensors through
+JNI per call (TFNet.scala:201-281).  Here the graph is converted ONCE to a
+JAX function (:mod:`.converter`), so "forward" is an XLA computation fused
+with whatever surrounds it, and gradients flow through it natively (the
+reference needed an exported backward graph + gradWeights smuggling,
+TFNet.scala:301-369).
+
+``export_tf`` mirrors pyzoo/zoo/util/tf.py:29-300: freeze variables to
+constants, strip unused nodes, write ``frozen_inference_graph.pb`` +
+``graph_meta.json``.  The reference's backward-graph generation
+(tf.py:116-187) is intentionally absent — jax.grad supersedes it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....core.module import Layer, register_layer
+from .converter import ConvertedGraph
+
+_FROZEN_PB = "frozen_inference_graph.pb"
+_META = "graph_meta.json"
+
+
+def export_tf(sess, folder: str, inputs: Sequence, outputs: Sequence):
+    """Freeze ``sess``'s graph to constants and write pb + meta
+    (reference export_tf, pyzoo/zoo/util/tf.py:29-114)."""
+    import tensorflow as tf
+
+    input_names = [t.name if hasattr(t, "name") else str(t)
+                   for t in inputs]
+    output_names = [t.name if hasattr(t, "name") else str(t)
+                    for t in outputs]
+    graph_def = sess.graph.as_graph_def()
+    out_ops = [n.split(":")[0] for n in output_names]
+    frozen = tf.compat.v1.graph_util.convert_variables_to_constants(
+        sess, graph_def, out_ops)
+    frozen = tf.compat.v1.graph_util.extract_sub_graph(frozen, out_ops)
+    os.makedirs(folder, exist_ok=True)
+    with open(os.path.join(folder, _FROZEN_PB), "wb") as f:
+        f.write(frozen.SerializeToString())
+    with open(os.path.join(folder, _META), "w") as f:
+        json.dump({"input_names": input_names,
+                   "output_names": output_names,
+                   "temp_tensors": [], "variables": [],
+                   "grad_variables": [], "grad_inputs": []}, f)
+    return folder
+
+
+@register_layer
+class TFNet(Layer):
+    """A TF graph embedded as a layer of this framework.
+
+    Construction mirrors the reference object TFNet (TFNet.scala:549-611):
+    from an export folder (pb + graph_meta.json), a raw .pb path with
+    explicit input/output names, or live from a session.  When the graph
+    still carries variables, they become trainable params of the layer.
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 input_names: Optional[Sequence[str]] = None,
+                 output_names: Optional[Sequence[str]] = None,
+                 graph_def=None,
+                 initial_params: Optional[dict] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        if graph_def is None:
+            graph_def, input_names, output_names = _load_graph(
+                path, input_names, output_names)
+        self._graph_path = path
+        self.fn = ConvertedGraph(graph_def, list(input_names),
+                                 list(output_names))
+        self._initial_params = dict(initial_params or {})
+        missing = [v for v in self.fn.variable_names
+                   if v not in self._initial_params]
+        if missing:
+            raise ValueError(
+                f"graph has variables with no values: {missing}; freeze "
+                "the graph (export_tf / from_session) or pass "
+                "initial_params")
+
+    @classmethod
+    def from_session(cls, sess, inputs: Sequence, outputs: Sequence,
+                     freeze: bool = True) -> "TFNet":
+        """Convert the session's graph; by default variables are frozen
+        into constants (reference TFNet.fromSession).  With
+        ``freeze=False`` variable values become trainable layer params."""
+        import tensorflow as tf
+
+        input_names = [t.name if hasattr(t, "name") else str(t)
+                       for t in inputs]
+        output_names = [t.name if hasattr(t, "name") else str(t)
+                        for t in outputs]
+        gd = sess.graph.as_graph_def()
+        if freeze:
+            out_ops = [n.split(":")[0] for n in output_names]
+            gd = tf.compat.v1.graph_util.convert_variables_to_constants(
+                sess, gd, out_ops)
+            return cls(graph_def=gd, input_names=input_names,
+                       output_names=output_names)
+        net = cls.__new__(cls)
+        Layer.__init__(net)
+        net._graph_path = None
+        net.fn = ConvertedGraph(gd, input_names, output_names)
+        values = {}
+        var_ops = {v.op.name: v for v in
+                   sess.graph.get_collection(
+                       tf.compat.v1.GraphKeys.GLOBAL_VARIABLES)}
+        with sess.graph.as_default():
+            for vname in net.fn.variable_names:
+                if vname not in var_ops:
+                    raise ValueError(f"no live variable for node {vname!r}")
+                values[vname] = np.asarray(
+                    sess.run(var_ops[vname].value()))
+        net._initial_params = values
+        return net
+
+    # ---- Layer contract ------------------------------------------------
+    stochastic = True  # converted graphs may contain dropout
+
+    def init_params(self, rng, input_shape):
+        return {k: jnp.asarray(v) for k, v in self._initial_params.items()}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        xs = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+        outs = self.fn(params, *xs, rng=rng, training=training)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def compute_output_shape(self, input_shape):
+        shapes = input_shape if isinstance(input_shape[0], (tuple, list)) \
+            else [input_shape]
+        dummies = [jax.ShapeDtypeStruct((2,) + tuple(s[1:]), jnp.float32)
+                   for s in shapes]
+        params = {k: jax.ShapeDtypeStruct(np.shape(v), jnp.float32)
+                  for k, v in self._initial_params.items()}
+        out = jax.eval_shape(
+            lambda p, *xs: self.fn(p, *xs,
+                                   rng=jax.random.PRNGKey(0)
+                                   if self.fn else None),
+            params, *dummies)
+        outs = [(None,) + tuple(o.shape[1:]) for o in out]
+        return outs[0] if len(outs) == 1 else outs
+
+    # ---- convenience inference (reference TFNet predict path) ----------
+    def predict(self, x, batch_per_thread: int = 32) -> np.ndarray:
+        params = self.init_params(jax.random.PRNGKey(0), None)
+        xs = x if isinstance(x, (tuple, list)) else (x,)
+        # frozen graphs may retain dropout/random nodes (the reference's TF
+        # runtime just executed them at inference); feed a fixed key
+        fwd = jax.jit(
+            lambda p, *a: self.fn(p, *a, rng=jax.random.PRNGKey(0)))
+        outs = []
+        n = len(xs[0])
+        bs = batch_per_thread
+        for i in range(0, n, bs):
+            batch = [np.asarray(a[i:i + bs]) for a in xs]
+            outs.append([np.asarray(o) for o in fwd(params, *batch)])
+        cat = [np.concatenate([o[j] for o in outs])
+               for j in range(len(outs[0]))]
+        return cat[0] if len(cat) == 1 else cat
+
+
+def _load_graph(path, input_names, output_names):
+    import tensorflow as tf
+
+    if os.path.isdir(path):
+        meta_path = os.path.join(path, _META)
+        with open(meta_path) as f:
+            meta = json.load(f)
+        input_names = meta["input_names"]
+        output_names = meta["output_names"]
+        pb = os.path.join(path, _FROZEN_PB)
+    else:
+        pb = path
+        if input_names is None or output_names is None:
+            raise ValueError(
+                "loading a bare .pb requires input_names and output_names")
+    gd = tf.compat.v1.GraphDef()
+    with open(pb, "rb") as f:
+        gd.ParseFromString(f.read())
+    return gd, input_names, output_names

@@ -1,0 +1,257 @@
+"""TFOptimizer / TFPredictor: train & serve user TF graphs on TPU.
+
+Parity surface: reference ``TFOptimizer`` (pyzoo/zoo/pipeline/api/net.py:
+326-430) exports the user's loss graph *plus a symbolically generated
+backward graph*, wraps it in a TFTrainingHelper BigDL layer whose forward
+smuggles gradients out through extra outputs, pairs it with
+IdentityCriterion, and runs the BigDL DistriOptimizer (2 Spark jobs per
+step); afterwards it copies trained weights back into the live tf.Session
+(net.py:426-429).  ``TFPredictor`` (net.py:523-551) freezes outputs and maps
+the dataset RDD through a TFNet.
+
+TPU translation: the loss graph converts to a JAX scalar function;
+``jax.grad`` replaces the exported backward graph; the IdentityCriterion
+trick survives as ``loss_fn = λ(y, ŷ): ŷ`` feeding the shared SPMD
+``Trainer`` (grad → psum over ICI → optax update, one compiled step);
+weights still get pushed back into the user's session at the end.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....core.module import Layer
+from ....data.dataset import Dataset
+from ....train import triggers as trigger_lib
+from ....train.trainer import Trainer
+from ..keras import optimizers as keras_optimizers
+from .converter import ConvertedGraph
+from .dataset import TFDataset, find_dataset
+
+
+def _find_placeholder_names(tensors) -> List[str]:
+    """Graph-walk discovery of the placeholders feeding ``tensors``
+    (reference _find_placeholders, net.py:271-305)."""
+    seen, out, stack = set(), [], [t.op for t in tensors]
+    while stack:
+        op = stack.pop()
+        if op.name in seen:
+            continue
+        seen.add(op.name)
+        if op.type == "Placeholder":
+            out.append(op.name)
+        stack.extend(i.op for i in op.inputs)
+    return sorted(out)
+
+
+def _reachable_param_values(sess, conv: ConvertedGraph) -> Dict[str, Any]:
+    """Read live values for every variable node the converted graph
+    touches (V1 and resource variables)."""
+    import tensorflow as tf
+
+    var_ops = {}
+    for coll in (tf.compat.v1.GraphKeys.GLOBAL_VARIABLES,
+                 tf.compat.v1.GraphKeys.LOCAL_VARIABLES):
+        for v in sess.graph.get_collection(coll):
+            var_ops[v.op.name] = v
+    values = {}
+    with sess.graph.as_default():
+        for name in conv.variable_names:
+            if name not in var_ops:
+                raise ValueError(
+                    f"graph variable {name!r} has no live tf.Variable; "
+                    "run the variable initializer first")
+            values[name] = np.asarray(sess.run(var_ops[name].value()))
+    return values
+
+
+class _GraphModel(Layer):
+    """Adapter: a converted loss graph as a Trainer-compatible model.
+
+    The dataset feeds ALL slots (features AND labels — the loss graph
+    consumes labels as placeholders, like the reference where labels ride
+    the miniBatch into TFTrainingHelper); output is the scalar loss, and
+    the Trainer's loss_fn is identity (IdentityCriterion parity,
+    TFTrainingHelper.scala:158-171)."""
+
+    stochastic = True
+
+    def __init__(self, conv: ConvertedGraph, trainable: Dict[str, Any],
+                 frozen: Dict[str, Any]):
+        super().__init__(name="tf_graph_model")
+        self.conv = conv
+        self._trainable = trainable
+        self._frozen = frozen
+
+    def init_params(self, rng, input_shape):
+        return {k: jnp.asarray(v) for k, v in self._trainable.items()}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        xs = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+        full = dict(params)
+        full.update({k: jnp.asarray(v) for k, v in self._frozen.items()})
+        outs = self.conv(full, *xs, rng=rng, training=training)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def compute_output_shape(self, input_shape):
+        return ()
+
+
+class TFOptimizer:
+    """Drive a user-written TF loss graph data-parallel on the TPU mesh."""
+
+    def __init__(self, loss, optim_method="sgd", sess=None,
+                 val_outputs: Optional[Sequence] = None,
+                 val_labels: Optional[Sequence] = None,
+                 val_method=None, clip_norm: Optional[float] = None,
+                 clip_value=None, metrics: Sequence = ()):
+        import tensorflow as tf
+
+        self.loss = loss
+        graph = loss.graph
+        self._owns_session = sess is None
+        if sess is None:
+            sess = tf.compat.v1.Session(graph=graph)
+            with graph.as_default():
+                sess.run(tf.compat.v1.global_variables_initializer())
+        self.sess = sess
+
+        ph_names = _find_placeholder_names([loss])
+        self.dataset, _ = find_dataset(graph, ph_names)
+        input_names = [ph.name for ph in self.dataset.tensors]
+        self._conv = ConvertedGraph(graph.as_graph_def(), input_names,
+                                    [loss.name])
+        values = _reachable_param_values(sess, self._conv)
+        trainable_ops = {v.op.name: v for v in graph.get_collection(
+            tf.compat.v1.GraphKeys.TRAINABLE_VARIABLES)}
+        self._trainable_vars = {n: v for n, v in trainable_ops.items()
+                                if n in values}
+        trainable = {n: values[n] for n in self._trainable_vars}
+        frozen = {n: v for n, v in values.items()
+                  if n not in self._trainable_vars}
+        self._model = _GraphModel(self._conv, trainable, frozen)
+
+        optimizer = keras_optimizers.get(optim_method, clip_norm=clip_norm,
+                                         clip_value=clip_value)
+        self.trainer = Trainer(self._model, loss_fn=lambda y, yp: yp,
+                               optimizer=optimizer)
+
+        # validation graph: outputs vs labels through user-chosen metrics
+        self._val = None
+        if val_outputs is not None and val_labels is not None:
+            methods = val_method if isinstance(val_method, (list, tuple)) \
+                else [val_method] if val_method is not None else []
+            vconv = ConvertedGraph(
+                graph.as_graph_def(), input_names,
+                [t.name for t in val_outputs] + [t.name for t in val_labels])
+            self._val = (vconv, len(val_outputs), list(methods) or
+                         list(metrics))
+
+    # -- reference API ---------------------------------------------------
+    def set_train_summary(self, summary):
+        self.trainer.train_summary = summary
+
+    def set_val_summary(self, summary):
+        self.trainer.val_summary = summary
+
+    def set_checkpoint(self, path: str, over_write: bool = True,
+                       trigger=None):
+        self.trainer.set_checkpoint(path, over_write, trigger)
+
+    def optimize(self, end_trigger=None, shuffle: bool = True,
+                 verbose: bool = False):
+        """Run to ``end_trigger`` (default: one epoch), then write trained
+        weights back into the live tf.Session (reference net.py:419-429)."""
+        ds = Dataset(tuple(self.dataset.arrays))
+        history = self.trainer.fit(
+            ds, self.dataset.batch_size,
+            end_trigger=end_trigger or trigger_lib.MaxEpoch(
+                self.trainer.state.epoch + 1
+                if self.trainer.state else 1),
+            shuffle=shuffle, verbose=verbose)
+        if self._val is not None:
+            history.setdefault("val", []).append(self.evaluate())
+        self._push_weights_to_session()
+        return history
+
+    def evaluate(self, batch_size: Optional[int] = None) -> Dict[str, float]:
+        """Run the validation outputs/labels graph over the validation
+        arrays (or training arrays when none were given) and apply the
+        metrics (reference TFValidationMethod, TFTrainingHelper.scala:
+        173-217)."""
+        if self._val is None:
+            raise ValueError("no val_outputs/val_labels configured")
+        vconv, n_out, methods = self._val
+        arrays = self.dataset.val_arrays or self.dataset.arrays
+        bs = batch_size or self.dataset.batch_size
+        params = {**{k: jnp.asarray(v) for k, v in
+                     self._current_trainable().items()},
+                  **{k: jnp.asarray(v)
+                     for k, v in self._model._frozen.items()}}
+        fwd = jax.jit(lambda p, *xs: vconv(p, *xs,
+                                           rng=jax.random.PRNGKey(0)))
+        accs = [m.init() for m in methods]
+        n = len(arrays[0])
+        for i in range(0, n - n % bs or n, bs):
+            batch = [jnp.asarray(a[i:i + bs]) for a in arrays]
+            outs = fwd(params, *batch)
+            y_pred = outs[:n_out]
+            y_true = outs[n_out:]
+            accs = [m.update(a, y_true[0] if len(y_true) == 1 else y_true,
+                             y_pred[0] if len(y_pred) == 1 else y_pred)
+                    for m, a in zip(methods, accs)]
+        return {m.name: float(m.result(a))
+                for m, a in zip(methods, accs)}
+
+    # -- weight sync back to TF ------------------------------------------
+    def _current_trainable(self) -> Dict[str, np.ndarray]:
+        if self.trainer.state is None:
+            return self._model._trainable
+        return {k: np.asarray(v)
+                for k, v in jax.device_get(
+                    self.trainer.state.params).items()}
+
+    def _push_weights_to_session(self):
+        import tensorflow as tf
+
+        values = self._current_trainable()
+        graph = self.sess.graph
+        with graph.as_default():
+            for name, var in self._trainable_vars.items():
+                ph = tf.compat.v1.placeholder(var.dtype.base_dtype,
+                                              var.shape)
+                self.sess.run(var.assign(ph), feed_dict={ph: values[name]})
+
+
+class TFPredictor:
+    """Distributed inference over a TFDataset (reference net.py:523-551)."""
+
+    def __init__(self, sess, outputs: Sequence, dataset:
+                 Optional[TFDataset] = None):
+        ph_names = _find_placeholder_names(list(outputs))
+        if dataset is None:
+            dataset, _ = find_dataset(sess.graph, ph_names)
+        self.dataset = dataset
+        input_names = [ph.name for ph in dataset.tensors]
+        conv = ConvertedGraph(sess.graph.as_graph_def(), input_names,
+                              [t.name for t in outputs])
+        self._params = {k: jnp.asarray(v) for k, v in
+                        _reachable_param_values(sess, conv).items()}
+        self._fwd = jax.jit(lambda p, *xs: conv(p, *xs))
+
+    def predict(self) -> Any:
+        arrays = self.dataset.arrays
+        bs = self.dataset.batch_size
+        n = len(arrays[0])
+        outs: List[List[np.ndarray]] = []
+        for i in range(0, n, bs):
+            batch = [jnp.asarray(a[i:i + bs]) for a in arrays]
+            outs.append([np.asarray(o)
+                         for o in self._fwd(self._params, *batch)])
+        cat = [np.concatenate([o[j] for o in outs])
+               for j in range(len(outs[0]))]
+        return cat[0] if len(cat) == 1 else cat

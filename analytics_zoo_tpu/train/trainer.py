@@ -142,17 +142,52 @@ class Trainer:
                                 rng=loop_rng)
 
     def adopt_weights(self, params, model_state=None):
-        """Replace weights with an externally provided pytree (same
-        structure), re-placed under this trainer's shardings — used when
-        compile() supersedes an inference-only trainer so pre-loaded
-        weights survive."""
-        self.ensure_initialized()
-        self.state.params = jax.tree_util.tree_map(
+        """Replace weights with an externally provided pytree, re-placed
+        under this trainer's shardings — used when compile() supersedes an
+        inference-only trainer so pre-loaded weights survive.
+
+        Shardings come from ``jax.eval_shape`` (abstract init) so no
+        throwaway random initialization is materialized.  Raises
+        ValueError when the provided tree doesn't match the model's
+        parameter structure/shapes (e.g. the architecture changed since
+        the weights were produced)."""
+        rng = jax.random.PRNGKey(self.seed)
+        init_rng, loop_rng = jax.random.split(rng)
+        abs_params, abs_state = jax.eval_shape(
+            lambda r: self.model.init(
+                r, getattr(self.model, "batch_input_shape", None)),
+            init_rng)
+        same_struct = (jax.tree_util.tree_structure(params)
+                       == jax.tree_util.tree_structure(abs_params))
+        if not same_struct or any(
+                tuple(np.shape(p)) != tuple(a.shape)
+                for p, a in zip(jax.tree_util.tree_leaves(params),
+                                jax.tree_util.tree_leaves(abs_params))):
+            raise ValueError(
+                "adopted weights do not match the model's parameter "
+                "structure (did the architecture change?)")
+        self._param_shardings = sharding_lib.shard_params(
+            abs_params, self.mesh, self.strategy)
+        placed = jax.tree_util.tree_map(
             lambda p, s: jax.device_put(p, s), params,
             self._param_shardings)
-        if model_state is not None:
-            self.state.model_state = jax.device_put(
-                model_state, self._repl_sharding)
+        if model_state is None:
+            if jax.tree_util.tree_leaves(abs_state):
+                # stateful model with no adopted state: materialize one
+                _, model_state = self.model.init(
+                    init_rng, getattr(self.model, "batch_input_shape",
+                                      None))
+            else:
+                model_state = abs_state
+        model_state = jax.device_put(model_state, self._repl_sharding)
+        if self.state is None:
+            self.state = TrainState(placed, model_state,
+                                    self.optimizer.init(placed),
+                                    rng=loop_rng)
+        else:
+            self.state.params = placed
+            self.state.model_state = model_state
+            self.state.opt_state = self.optimizer.init(placed)
 
     # ------------------------------------------------------------------
     def _build_train_step(self):
