@@ -1,0 +1,58 @@
+"""Shared static-vs-traced dispatch layer for the graph importers.
+
+Both graph converters (tfgraph/converter.py and onnx/converter.py) keep
+shape-math subgraphs host-side in numpy so traced shapes stay static under
+``jit``.  This module is the single home of that dispatch logic so the two
+importers cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import jax
+
+
+def is_static(v) -> bool:
+    return isinstance(v, (np.ndarray, np.generic, int, float, bool))
+
+
+def require_static(v, what: str):
+    """Require a host-static value (shape math); fail with guidance."""
+    if not is_static(v):
+        raise ValueError(
+            f"{what} must be statically known for XLA (got a traced "
+            "value); keep shape-producing subgraphs free of graph inputs")
+    return np.asarray(v)
+
+
+def static_ints(v, what: str) -> List[int]:
+    return [int(x) for x in np.atleast_1d(require_static(v, what))]
+
+
+def np_or_jnp(np_fn, jnp_fn):
+    """N-ary op that stays in numpy when all args are static."""
+    def h(*args):
+        if all(is_static(a) for a in args):
+            return np_fn(*args)
+        return jnp_fn(*args)
+    return h
+
+
+class ConvertCtx:
+    """Per-call conversion context: params, threaded rng, training flag."""
+
+    def __init__(self, params, rng, training):
+        self.params = params
+        self.rng = rng
+        self.training = training
+        self.node_seq = 0
+
+    def next_rng(self):
+        if self.rng is None:
+            raise ValueError(
+                "graph contains random ops (dropout?); pass rng= to the "
+                "converted function")
+        self.node_seq += 1
+        return jax.random.fold_in(self.rng, self.node_seq)

@@ -77,9 +77,15 @@ class KerasNet(Layer):
             try:
                 self.trainer.adopt_weights(prev_state.params,
                                            prev_state.model_state)
-            except ValueError:
-                # architecture changed since those weights were made
-                # (e.g. add() after fit): start from a fresh init
+            except ValueError as e:
+                if self._weights_loaded:
+                    # weights the user explicitly loaded/set must never be
+                    # dropped silently
+                    raise ValueError(
+                        f"loaded weights no longer match the model "
+                        f"architecture at compile time: {e}") from e
+                # weights from a previous compile of a since-changed
+                # architecture (e.g. add() after fit): fresh init
                 pass
         if self._tensorboard:
             self.trainer.set_tensorboard(*self._tensorboard)

@@ -56,6 +56,15 @@ class Net:
             "pytorch interop convert weights to a checkpoint pytree")
 
     @staticmethod
+    def load_onnx(path: str):
+        """Load an ``.onnx`` model as an :class:`OnnxNet` layer (reference
+        OnnxLoader, pyzoo/zoo/pipeline/api/onnx/onnx_loader.py:32-119).
+        Uses the built-in protobuf codec — the ``onnx`` package is not
+        required."""
+        from .onnx import load_onnx
+        return load_onnx(path)
+
+    @staticmethod
     def load_tf(path: str):
         raise NotImplementedError(
             "Frozen-GraphDef import is replaced in the TPU build: wrap "

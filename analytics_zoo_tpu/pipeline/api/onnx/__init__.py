@@ -1,0 +1,7 @@
+"""ONNX import (reference: pyzoo/zoo/pipeline/api/onnx/)."""
+
+from .onnx_loader import OnnxLoader, OnnxNet, load_onnx
+from .converter import OnnxGraph
+from . import proto
+
+__all__ = ["OnnxLoader", "OnnxNet", "load_onnx", "OnnxGraph", "proto"]

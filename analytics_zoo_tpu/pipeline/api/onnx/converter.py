@@ -1,0 +1,713 @@
+"""ONNX GraphProto → pure JAX function.
+
+Parity surface: the reference ONNX importer
+(pyzoo/zoo/pipeline/api/onnx/onnx_loader.py:32-119 + mapper/*.py, ~21 op
+mappers) converts each node into a BigDL Keras layer.  Here each node maps
+to a jnp/lax expression, so an imported model is ONE traceable function —
+XLA fuses the whole graph and jax.grad differentiates it (the reference
+could only fine-tune through layers its mappers produced).
+
+Design notes (same stance as ..tfgraph.converter):
+* ONNX convs/pools are NCHW; we keep that layout inside the imported
+  function — XLA lays out for the MXU regardless of the logical order.
+* Shape-feeding subgraphs (Shape → Concat → Reshape, Slice starts/ends,
+  Pad pads, ...) are evaluated host-side in numpy so traced shapes stay
+  static under jit.  Int64 initializers and Constant nodes start static;
+  float initializers become params (trainable fine-tuning for free).
+* Unsupported ops fail at conversion time with the op list, not mid-trace.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .proto import GraphProto, NodeProto, attrs_dict, tensor_to_numpy
+from .._convert_util import (ConvertCtx as _Ctx, is_static as _is_static,
+                             np_or_jnp as _nb, require_static as _static,
+                             static_ints as _ints)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool helpers (ONNX: NCHW, weights OIHW, pads = [b..., e...])
+
+def _spatial_rank(x) -> int:
+    return x.ndim - 2
+
+
+def _dim_numbers(rank: int):
+    sp = "DHW"[-rank:] if rank <= 3 else None
+    if sp is None:
+        raise NotImplementedError(f"conv/pool spatial rank {rank}")
+    return ("NC" + sp, "OI" + sp, "NC" + sp)
+
+
+def _pad_pairs(attrs, rank) -> List[Tuple[int, int]]:
+    pads = attrs.get("pads")
+    if pads is None:
+        return [(0, 0)] * rank
+    return [(int(pads[i]), int(pads[i + rank])) for i in range(rank)]
+
+
+def _auto_pad(attrs, rank, ks, strides):
+    ap = attrs.get("auto_pad", "NOTSET")
+    if ap in ("NOTSET", ""):
+        return _pad_pairs(attrs, rank)
+    if ap == "VALID":
+        return [(0, 0)] * rank
+    # SAME_UPPER / SAME_LOWER
+    pairs = []
+    for k, s in zip(ks, strides):
+        total = max(k - s, 0) if s <= k else 0
+        lo = total // 2
+        hi = total - lo
+        pairs.append((hi, lo) if ap == "SAME_LOWER" else (lo, hi))
+    return pairs
+
+
+def _conv(ctx, node, attrs, args):
+    x, w = args[0], args[1]
+    rank = _spatial_rank(x)
+    ks = attrs.get("kernel_shape", list(w.shape[2:]))
+    strides = attrs.get("strides", [1] * rank)
+    dil = attrs.get("dilations", [1] * rank)
+    group = attrs.get("group", 1)
+    pads = _auto_pad(attrs, rank, ks, strides)
+    out = lax.conv_general_dilated(
+        x, w, tuple(strides), pads, rhs_dilation=tuple(dil),
+        dimension_numbers=_dim_numbers(rank), feature_group_count=group)
+    if len(args) > 2 and args[2] is not None:
+        b = args[2]
+        out = out + jnp.reshape(b, (1, -1) + (1,) * rank)
+    return out
+
+
+def _conv_transpose(ctx, node, attrs, args):
+    x, w = args[0], args[1]
+    rank = _spatial_rank(x)
+    strides = attrs.get("strides", [1] * rank)
+    dil = attrs.get("dilations", [1] * rank)
+    group = attrs.get("group", 1)
+    if group != 1:
+        raise NotImplementedError("grouped ConvTranspose")
+    pads = _pad_pairs(attrs, rank)
+    out_pad = attrs.get("output_padding", [0] * rank)
+    # ONNX ConvTranspose weight layout is (Cin, Cout/g, *k); lax wants IO
+    dn = ("NC" + "DHW"[-rank:], "IO" + "DHW"[-rank:], "NC" + "DHW"[-rank:])
+    # conv_transpose padding: ONNX pads shrink the output
+    tpads = [(d * (k - 1) - p0, d * (k - 1) - p1 + op)
+             for (p0, p1), k, d, op in zip(
+                 pads, w.shape[2:], dil, out_pad)]
+    out = lax.conv_general_dilated(
+        x, w, (1,) * rank, tpads, lhs_dilation=tuple(strides),
+        rhs_dilation=tuple(dil), dimension_numbers=dn,
+        transpose_kernel=True)
+    if len(args) > 2 and args[2] is not None:
+        out = out + jnp.reshape(args[2], (1, -1) + (1,) * rank)
+    return out
+
+
+def _pool(reducer, init, is_avg=False):
+    def h(ctx, node, attrs, args):
+        (x,) = args
+        rank = _spatial_rank(x)
+        ks = attrs["kernel_shape"]
+        strides = attrs.get("strides", [1] * rank)
+        if attrs.get("ceil_mode", 0):
+            raise NotImplementedError("pool ceil_mode=1")
+        pads = _auto_pad(attrs, rank, ks, strides)
+        window = (1, 1) + tuple(ks)
+        wstrides = (1, 1) + tuple(strides)
+        wpads = [(0, 0), (0, 0)] + pads
+        summed = lax.reduce_window(x, jnp.asarray(init, x.dtype), reducer,
+                                   window, wstrides, wpads)
+        if not is_avg:
+            return summed
+        if attrs.get("count_include_pad", 0):
+            return summed / np.prod(ks)
+        ones = jnp.ones(x.shape, x.dtype)
+        counts = lax.reduce_window(ones, jnp.zeros((), x.dtype), lax.add,
+                                   window, wstrides, wpads)
+        return summed / counts
+    return h
+
+
+def _global_pool(fn):
+    def h(ctx, node, attrs, args):
+        (x,) = args
+        axes = tuple(range(2, x.ndim))
+        return fn(x, axis=axes, keepdims=True)
+    return h
+
+
+def _gemm(ctx, node, attrs, args):
+    a, b = args[0], args[1]
+    if attrs.get("transA", 0):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("transB", 0):
+        b = jnp.swapaxes(b, -1, -2)
+    out = attrs.get("alpha", 1.0) * jnp.matmul(a, b)
+    if len(args) > 2 and args[2] is not None:
+        out = out + attrs.get("beta", 1.0) * args[2]
+    return out
+
+
+def _batch_norm(ctx, node, attrs, args):
+    x, scale, bias, mean, var = args[:5]
+    eps = attrs.get("epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    rs = lambda t: jnp.reshape(t, shape)
+    return (x - rs(mean)) * rs(scale) * lax.rsqrt(rs(var) + eps) + rs(bias)
+
+
+def _instance_norm(ctx, node, attrs, args):
+    x, scale, bias = args
+    eps = attrs.get("epsilon", 1e-5)
+    red = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=red, keepdims=True)
+    v = jnp.var(x, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - m) * lax.rsqrt(v + eps) * jnp.reshape(
+        scale, shape) + jnp.reshape(bias, shape)
+
+
+def _lrn(ctx, node, attrs, args):
+    (x,) = args
+    size = attrs["size"]
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    bias = attrs.get("bias", 1.0)
+    sq = jnp.square(x)
+    half = size // 2
+    # sum over channel window via reduce_window on axis 1
+    window = (1, size) + (1,) * (x.ndim - 2)
+    pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    ssum = lax.reduce_window(sq, jnp.zeros((), x.dtype), lax.add,
+                             window, (1,) * x.ndim, pads)
+    return x / jnp.power(bias + (alpha / size) * ssum, beta)
+
+
+def _dropout(ctx, node, attrs, args):
+    x = args[0]
+    ratio = attrs.get("ratio", 0.5)
+    if len(args) > 1 and args[1] is not None:
+        ratio = float(_static(args[1], "Dropout ratio").item())
+    training = ctx.training
+    if len(args) > 2 and args[2] is not None:
+        training = bool(_static(args[2], "Dropout training_mode").item())
+    n_out = len(node.output)
+    if not training or ratio == 0.0:
+        mask = jnp.ones(x.shape, bool)
+        return (x, mask) if n_out > 1 else x
+    keep = jax.random.bernoulli(ctx.next_rng(), 1.0 - ratio, x.shape)
+    y = jnp.where(keep, x / (1.0 - ratio), 0.0).astype(x.dtype)
+    return (y, keep) if n_out > 1 else y
+
+
+def _reshape(ctx, node, attrs, args):
+    x, shape = args[0], args[1] if len(args) > 1 else attrs.get("shape")
+    tgt = _ints(shape, "Reshape shape")
+    in_shape = np.asarray(x).shape if _is_static(x) else x.shape
+    # ONNX: 0 = copy input dim (unless allowzero), -1 = infer
+    tgt = [in_shape[i] if d == 0 and not attrs.get("allowzero", 0) else d
+           for i, d in enumerate(tgt)]
+    if _is_static(x):
+        return np.reshape(np.asarray(x), tgt)
+    return jnp.reshape(x, tgt)
+
+
+def _flatten(ctx, node, attrs, args):
+    (x,) = args
+    ax = attrs.get("axis", 1)
+    if ax < 0:  # ONNX: negative axis counts from the rank (axis += r)
+        ax += x.ndim
+    lead = int(np.prod(x.shape[:ax])) if ax else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+def _squeeze(ctx, node, attrs, args):
+    x = args[0]
+    axes = attrs.get("axes")
+    if len(args) > 1 and args[1] is not None:
+        axes = _ints(args[1], "Squeeze axes")
+    f = _nb(np.squeeze, jnp.squeeze)
+    return f(x) if axes is None else f(x, tuple(int(a) for a in axes))
+
+
+def _unsqueeze(ctx, node, attrs, args):
+    x = args[0]
+    axes = attrs.get("axes")
+    if len(args) > 1 and args[1] is not None:
+        axes = _ints(args[1], "Unsqueeze axes")
+    out = x
+    for ax in sorted(int(a) for a in axes):
+        out = (np.expand_dims(out, ax) if _is_static(out)
+               else jnp.expand_dims(out, ax))
+    return out
+
+
+def _slice(ctx, node, attrs, args):
+    x = args[0]
+    if len(args) > 1:  # opset >= 10: starts/ends/axes/steps are inputs
+        starts = _ints(args[1], "Slice starts")
+        ends = _ints(args[2], "Slice ends")
+        axes = (_ints(args[3], "Slice axes") if len(args) > 3 and
+                args[3] is not None else list(range(len(starts))))
+        steps = (_ints(args[4], "Slice steps") if len(args) > 4 and
+                 args[4] is not None else [1] * len(starts))
+    else:  # opset < 10: attributes
+        starts = attrs["starts"]
+        ends = attrs["ends"]
+        axes = attrs.get("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    ndim = np.asarray(x).ndim if _is_static(x) else x.ndim
+    idx: List[Any] = [slice(None)] * ndim
+    INT64_MAX = (1 << 63) - 1
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        e = None if e >= INT64_MAX - 1 else e
+        s_ = None if (st < 0 and s >= INT64_MAX - 1) else s
+        e_ = None if (st < 0 and e is not None and e < -(1 << 62)) else e
+        idx[a % ndim] = slice(s_, e_, st)
+    return (np.asarray(x) if _is_static(x) else x)[tuple(idx)]
+
+
+def _gather(ctx, node, attrs, args):
+    data, indices = args
+    axis = attrs.get("axis", 0)
+    f = _nb(lambda d, i: np.take(d, np.asarray(i, np.int64), axis=axis),
+            lambda d, i: jnp.take(d, i, axis=axis))
+    return f(data, indices)
+
+
+def _pad(ctx, node, attrs, args):
+    x = args[0]
+    mode = attrs.get("mode", "constant")
+    if len(args) > 1 and args[1] is not None:
+        pads = _ints(args[1], "Pad pads")
+        cval = (float(np.asarray(_static(args[2], "Pad value")).item())
+                if len(args) > 2 and args[2] is not None else 0.0)
+    else:
+        pads = attrs["pads"]
+        cval = attrs.get("value", 0.0)
+    n = len(pads) // 2
+    pairs = [(pads[i], pads[i + n]) for i in range(n)]
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=cval)
+    return jnp.pad(x, pairs,
+                   mode="reflect" if mode == "reflect" else "edge")
+
+
+def _concat(ctx, node, attrs, args):
+    ax = attrs.get("axis", 0)
+    if all(_is_static(a) for a in args):
+        return np.concatenate([np.asarray(a) for a in args], axis=ax)
+    return jnp.concatenate(args, axis=ax)
+
+
+def _split(ctx, node, attrs, args):
+    x = args[0]
+    ax = attrs.get("axis", 0)
+    sizes = attrs.get("split")
+    if len(args) > 1 and args[1] is not None:
+        sizes = _ints(args[1], "Split sizes")
+    if sizes is None:
+        return tuple(jnp.split(x, len(node.output), axis=ax))
+    points = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, points, axis=ax))
+
+
+def _reduction(jnp_fn, np_fn):
+    def h(ctx, node, attrs, args):
+        x = args[0]
+        axes = attrs.get("axes")
+        if len(args) > 1 and args[1] is not None:
+            axes = _ints(args[1], "reduction axes")
+        keep = bool(attrs.get("keepdims", 1))
+        if axes is not None and len(axes) == 0:
+            # ONNX: empty axes reduces all dims unless noop_with_empty_axes
+            if attrs.get("noop_with_empty_axes", 0):
+                return x
+            ax = None
+        else:
+            ax = tuple(int(a) for a in axes) if axes is not None else None
+        if _is_static(x):
+            return np_fn(np.asarray(x), axis=ax, keepdims=keep)
+        return jnp_fn(x, axis=ax, keepdims=keep)
+    return h
+
+
+def _arg_reduce(fn):
+    def h(ctx, node, attrs, args):
+        (x,) = args
+        ax = attrs.get("axis", 0)
+        keep = bool(attrs.get("keepdims", 1))
+        out = fn(x, axis=ax).astype(jnp.int64)
+        return jnp.expand_dims(out, ax) if keep else out
+    return h
+
+
+def _clip(ctx, node, attrs, args):
+    x = args[0]
+    lo = attrs.get("min")
+    hi = attrs.get("max")
+    if len(args) > 1 and args[1] is not None:
+        lo = args[1]
+    if len(args) > 2 and args[2] is not None:
+        hi = args[2]
+    return jnp.clip(x, lo, hi)
+
+
+def _cast(ctx, node, attrs, args):
+    from .proto import np_dtype
+    (x,) = args
+    dt = np_dtype(attrs["to"])
+    if _is_static(x):
+        return np.asarray(x).astype(dt)
+    return x.astype(dt)
+
+
+def _softmax_like(fn):
+    def h(ctx, node, attrs, args):
+        (x,) = args
+        ax = attrs.get("axis", -1)
+        return fn(x, axis=ax)
+    return h
+
+
+def _constant(ctx, node, attrs, args):
+    if "value" in attrs:
+        return attrs["value"]
+    for k in ("value_float", "value_int"):
+        if k in attrs:
+            return np.asarray(attrs[k])
+    for k in ("value_floats", "value_ints"):
+        if k in attrs:
+            return np.asarray(attrs[k])
+    raise NotImplementedError(f"Constant node {node.name} with no value")
+
+
+def _constant_of_shape(ctx, node, attrs, args):
+    shape = tuple(_ints(args[0], "ConstantOfShape shape"))
+    val = attrs.get("value")
+    if val is None:
+        return np.zeros(shape, np.float32)
+    return np.full(shape, np.asarray(val).reshape(-1)[0],
+                   np.asarray(val).dtype)
+
+
+def _expand(ctx, node, attrs, args):
+    x, shape = args
+    tgt = _ints(shape, "Expand shape")
+    in_shape = np.asarray(x).shape if _is_static(x) else x.shape
+    # ONNX Expand: numpy broadcast; 1s in target keep the input dim
+    n = max(len(tgt), len(in_shape))
+    in_p = (1,) * (n - len(in_shape)) + tuple(in_shape)
+    tgt_p = [1] * (n - len(tgt)) + list(tgt)
+    out = [max(a, b) for a, b in zip(in_p, tgt_p)]
+    f = _nb(np.broadcast_to, jnp.broadcast_to)
+    return f(x, tuple(out))
+
+
+def _tile(ctx, node, attrs, args):
+    x, reps = args
+    f = _nb(np.tile, jnp.tile)
+    return f(x, tuple(_ints(reps, "Tile repeats")))
+
+
+def _onehot(ctx, node, attrs, args):
+    indices, depth, values = args
+    ax = attrs.get("axis", -1)
+    d = _ints(depth, "OneHot depth")[0]
+    off, on = np.asarray(_static(values, "OneHot values"))
+    oh = jax.nn.one_hot(indices, d, axis=ax)
+    return (oh * (on - off) + off)
+
+
+def _topk(ctx, node, attrs, args):
+    x = args[0]
+    k = (_ints(args[1], "TopK k")[0] if len(args) > 1
+         else attrs["k"])
+    ax = attrs.get("axis", -1)
+    if not attrs.get("largest", 1):
+        vals, idxs = lax.top_k(-jnp.moveaxis(x, ax, -1), k)
+        vals = -vals
+    else:
+        vals, idxs = lax.top_k(jnp.moveaxis(x, ax, -1), k)
+    return (jnp.moveaxis(vals, -1, ax),
+            jnp.moveaxis(idxs.astype(jnp.int64), -1, ax))
+
+
+def _where(ctx, node, attrs, args):
+    f = _nb(np.where, jnp.where)
+    return f(*args)
+
+
+def _ew(jnp_fn, np_fn=None):
+    def h(ctx, node, attrs, args):
+        (x,) = args
+        if np_fn is not None and _is_static(x):
+            return np_fn(x)
+        return jnp_fn(x)
+    return h
+
+
+def _bin(jnp_fn, np_fn):
+    f = _nb(np_fn, jnp_fn)
+    return lambda ctx, node, attrs, args: f(*args)
+
+
+def _variadic(jnp_fn):
+    def h(ctx, node, attrs, args):
+        out = args[0]
+        for a in args[1:]:
+            out = jnp_fn(out, a)
+        return out
+    return h
+
+
+_H: Dict[str, Any] = {
+    # plumbing
+    "Identity": lambda ctx, node, attrs, args: args[0],
+    "Constant": _constant,
+    "ConstantOfShape": _constant_of_shape,
+    "Cast": _cast,
+    "Shape": lambda ctx, node, attrs, args: np.asarray(
+        (np.asarray(args[0]).shape if _is_static(args[0])
+         else args[0].shape), np.int64),
+    "Size": lambda ctx, node, attrs, args: np.int64(int(np.prod(
+        (np.asarray(args[0]) if _is_static(args[0]) else args[0]).shape))),
+    "Dropout": _dropout,
+    # shape ops
+    "Reshape": _reshape,
+    "Flatten": _flatten,
+    "Transpose": lambda ctx, node, attrs, args: (
+        np.transpose(np.asarray(args[0]), attrs.get("perm"))
+        if _is_static(args[0])
+        else jnp.transpose(args[0], attrs.get("perm"))),
+    "Squeeze": _squeeze,
+    "Unsqueeze": _unsqueeze,
+    "Slice": _slice,
+    "Gather": _gather,
+    "Concat": _concat,
+    "Split": _split,
+    "Pad": _pad,
+    "Expand": _expand,
+    "Tile": _tile,
+    "Range": lambda ctx, node, attrs, args: np.arange(
+        *[np.asarray(_static(a, "Range")).item() for a in args]),
+    "OneHot": _onehot,
+    # math: binary (numpy-style broadcast)
+    "Add": _bin(jnp.add, np.add),
+    "Sub": _bin(jnp.subtract, np.subtract),
+    "Mul": _bin(jnp.multiply, np.multiply),
+    "Div": _bin(jnp.divide, np.divide),
+    "Pow": _bin(jnp.power, np.power),
+    "Mod": _bin(jnp.mod, np.mod),
+    "Min": _variadic(jnp.minimum),
+    "Max": _variadic(jnp.maximum),
+    "Sum": _variadic(jnp.add),
+    "Mean": lambda ctx, node, attrs, args: sum(args[1:], args[0]) / len(args),
+    "MatMul": _bin(jnp.matmul, np.matmul),
+    "Gemm": _gemm,
+    "Einsum": lambda ctx, node, attrs, args: jnp.einsum(
+        attrs["equation"], *args),
+    # math: unary
+    "Neg": _ew(jnp.negative, np.negative),
+    "Abs": _ew(jnp.abs, np.abs),
+    "Sqrt": _ew(jnp.sqrt),
+    "Exp": _ew(jnp.exp),
+    "Log": _ew(jnp.log),
+    "Reciprocal": _ew(jnp.reciprocal),
+    "Floor": _ew(jnp.floor, np.floor),
+    "Ceil": _ew(jnp.ceil, np.ceil),
+    "Round": _ew(jnp.round, np.round),
+    "Sign": _ew(jnp.sign, np.sign),
+    "Erf": _ew(lax.erf),
+    "Sin": _ew(jnp.sin),
+    "Cos": _ew(jnp.cos),
+    "Clip": _clip,
+    # activations
+    "Relu": _ew(jax.nn.relu),
+    "LeakyRelu": lambda ctx, node, attrs, args: jax.nn.leaky_relu(
+        args[0], attrs.get("alpha", 0.01)),
+    "PRelu": lambda ctx, node, attrs, args: jnp.where(
+        args[0] >= 0, args[0], args[0] * args[1]),
+    "Elu": lambda ctx, node, attrs, args: jax.nn.elu(
+        args[0], attrs.get("alpha", 1.0)),
+    "Selu": _ew(jax.nn.selu),
+    "Celu": lambda ctx, node, attrs, args: jax.nn.celu(
+        args[0], attrs.get("alpha", 1.0)),
+    "Sigmoid": _ew(jax.nn.sigmoid),
+    "HardSigmoid": lambda ctx, node, attrs, args: jnp.clip(
+        attrs.get("alpha", 0.2) * args[0] + attrs.get("beta", 0.5), 0, 1),
+    "Tanh": _ew(jnp.tanh),
+    "Softplus": _ew(jax.nn.softplus),
+    "Softsign": _ew(jax.nn.soft_sign),
+    "Softmax": _softmax_like(jax.nn.softmax),
+    "LogSoftmax": _softmax_like(jax.nn.log_softmax),
+    "Gelu": _ew(jax.nn.gelu),
+    # NN
+    "Conv": _conv,
+    "ConvTranspose": _conv_transpose,
+    "MaxPool": _pool(lax.max, -np.inf),
+    "AveragePool": _pool(lax.add, 0.0, is_avg=True),
+    "GlobalAveragePool": _global_pool(jnp.mean),
+    "GlobalMaxPool": _global_pool(jnp.max),
+    "BatchNormalization": _batch_norm,
+    "InstanceNormalization": _instance_norm,
+    "LRN": _lrn,
+    # reductions
+    "ReduceMean": _reduction(jnp.mean, np.mean),
+    "ReduceSum": _reduction(jnp.sum, np.sum),
+    "ReduceMax": _reduction(jnp.max, np.max),
+    "ReduceMin": _reduction(jnp.min, np.min),
+    "ReduceProd": _reduction(jnp.prod, np.prod),
+    "ReduceL2": _reduction(
+        lambda x, axis, keepdims: jnp.sqrt(
+            jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims)),
+        lambda x, axis, keepdims: np.sqrt(
+            np.sum(np.square(x), axis=axis, keepdims=keepdims))),
+    "ArgMax": _arg_reduce(jnp.argmax),
+    "ArgMin": _arg_reduce(jnp.argmin),
+    "TopK": _topk,
+    # comparison / logic
+    "Greater": _bin(jnp.greater, np.greater),
+    "GreaterOrEqual": _bin(jnp.greater_equal, np.greater_equal),
+    "Less": _bin(jnp.less, np.less),
+    "LessOrEqual": _bin(jnp.less_equal, np.less_equal),
+    "Equal": _bin(jnp.equal, np.equal),
+    "Not": _ew(jnp.logical_not, np.logical_not),
+    "And": _bin(jnp.logical_and, np.logical_and),
+    "Or": _bin(jnp.logical_or, np.logical_or),
+    "Xor": _bin(jnp.logical_xor, np.logical_xor),
+    "Where": _where,
+}
+
+
+class OnnxGraph:
+    """An ONNX GraphProto compiled to a callable JAX function.
+
+    ``fn = OnnxGraph(graph)``; then
+    ``fn(params, *inputs, rng=None, training=False) -> [outputs]``.
+
+    Float initializers become entries of ``fn.initial_params`` (trainable);
+    integer initializers stay host-static so shape-feeding subgraphs trace
+    to static shapes.
+    """
+
+    def __init__(self, graph: GraphProto):
+        self.graph = graph
+        init_names = {t.name for t in graph.initializer}
+        self.input_names: List[str] = [
+            vi.name for vi in graph.input if vi.name not in init_names]
+        self.output_names: List[str] = [vi.name for vi in graph.output]
+
+        self.initial_params: Dict[str, np.ndarray] = {}
+        self._static_consts: Dict[str, np.ndarray] = {}
+        for t in graph.initializer:
+            arr = tensor_to_numpy(t)
+            if np.issubdtype(arr.dtype, np.floating):
+                self.initial_params[t.name] = arr
+            else:
+                self._static_consts[t.name] = arr
+
+        self._producer: Dict[str, Tuple[NodeProto, int]] = {}
+        for node in graph.node:
+            for i, out in enumerate(node.output):
+                if out:
+                    self._producer[out] = (node, i)
+        missing_ops = sorted({n.op_type for n in graph.node
+                              if n.op_type not in _H})
+        if missing_ops:
+            raise NotImplementedError(
+                f"unsupported ONNX ops {missing_ops}; supported: "
+                f"{sorted(_H)}")
+        self._order = self._toposort()
+
+    def _toposort(self) -> List[NodeProto]:
+        """Iterative DFS (deep exported chains overflow Python's
+        recursion limit — same stance as tfgraph converter)."""
+        known = (set(self.input_names) | set(self.initial_params)
+                 | set(self._static_consts))
+        order: List[NodeProto] = []
+        state: Dict[int, int] = {}  # id(node): 0 visiting, 1 done
+
+        def deps(node):
+            for ref in node.input:
+                if ref and ref not in known:
+                    if ref not in self._producer:
+                        raise KeyError(
+                            f"node {node.name or node.op_type} consumes "
+                            f"unknown value {ref!r}")
+                    yield self._producer[ref][0]
+
+        stack = [(self._producer[out][0], False)
+                 for out in reversed(self.output_names)
+                 if out in self._producer]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                state[id(node)] = 1
+                order.append(node)
+                continue
+            s = state.get(id(node))
+            if s == 1:
+                continue
+            if s == 0:
+                # popped again while still unfinished: only a back-edge
+                # (cycle) can reach a node on the current DFS path
+                raise ValueError("ONNX graph has a cycle")
+            state[id(node)] = 0
+            stack.append((node, True))
+            for d in deps(node):
+                if state.get(id(d)) != 1:
+                    stack.append((d, False))
+        return order
+
+    def __call__(self, params: Dict[str, Any], *input_values,
+                 rng=None, training: bool = False):
+        if len(input_values) != len(self.input_names):
+            raise ValueError(
+                f"expected {len(self.input_names)} inputs "
+                f"({self.input_names}), got {len(input_values)}")
+        env: Dict[str, Any] = dict(self._static_consts)
+        env.update(params)
+        env.update(zip(self.input_names, input_values))
+        ctx = _Ctx(params, rng, training)
+        for node in self._order:
+            attrs = attrs_dict(node)
+            args = [env[r] if r else None for r in node.input]
+            out = _H[node.op_type](ctx, node, attrs, args)
+            if isinstance(out, tuple):
+                for name, v in zip(node.output, out):
+                    if name:
+                        env[name] = v
+            else:
+                env[node.output[0]] = out
+        missing = [o for o in self.output_names if o not in env]
+        if missing:
+            raise KeyError(f"graph outputs never produced: {missing}")
+        return [env[o] for o in self.output_names]
+
+    @property
+    def input_shapes(self) -> List[Optional[Tuple]]:
+        """Declared shapes from graph.input value_info (None dims for
+        symbolic/batch dims)."""
+        shapes = []
+        by_name = {vi.name: vi for vi in self.graph.input}
+        for name in self.input_names:
+            vi = by_name.get(name)
+            if vi is None or vi.type is None or vi.type.tensor_type is None \
+                    or vi.type.tensor_type.shape is None:
+                shapes.append(None)
+                continue
+            dims = []
+            for d in vi.type.tensor_type.shape.dim:
+                dims.append(int(d.dim_value) if d.dim_value else None)
+            shapes.append(tuple(dims))
+        return shapes

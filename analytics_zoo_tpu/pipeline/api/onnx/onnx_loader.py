@@ -1,0 +1,103 @@
+"""ONNX model → framework Layer (``OnnxNet``) / loader entry points.
+
+Parity surface: reference ``OnnxLoader``
+(pyzoo/zoo/pipeline/api/onnx/onnx_loader.py:32-119) turns an onnx GraphProto
+into a BigDL KerasNet by mapping each node to a layer.  Here the whole graph
+becomes one JAX function (:class:`.converter.OnnxGraph`) wrapped as a Layer,
+so an imported model composes with native layers, jits into one XLA
+computation, and fine-tunes through ``jax.grad`` (float initializers are the
+layer's params).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....core.module import Layer, register_layer
+from .converter import OnnxGraph
+from .proto import ModelProto, load_model
+
+
+@register_layer
+class OnnxNet(Layer):
+    """An imported ONNX model as a layer of this framework."""
+
+    stochastic = True  # imported graphs may contain Dropout
+
+    def __init__(self, path: Optional[str] = None,
+                 model: Optional[ModelProto] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        if model is None:
+            model = load_model(path)
+        self._path = path
+        if model.graph is None:
+            raise ValueError("ONNX model has no graph")
+        self.fn = OnnxGraph(model.graph)
+        self.opset = max((o.version for o in model.opset_import
+                          if o.domain in ("", "ai.onnx")), default=13)
+
+    # ---- Layer contract ------------------------------------------------
+    def init_params(self, rng, input_shape):
+        return {k: jnp.asarray(v)
+                for k, v in self.fn.initial_params.items()}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        xs = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+        outs = self.fn(params, *xs, rng=rng, training=training)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def compute_output_shape(self, input_shape):
+        shapes = input_shape if isinstance(input_shape[0], (tuple, list)) \
+            else [input_shape]
+        dummies = [jax.ShapeDtypeStruct((2,) + tuple(s[1:]), jnp.float32)
+                   for s in shapes]
+        params = {k: jax.ShapeDtypeStruct(np.shape(v), jnp.float32)
+                  for k, v in self.fn.initial_params.items()}
+        out = jax.eval_shape(
+            lambda p, *xs: self.fn(p, *xs, rng=jax.random.PRNGKey(0)),
+            params, *dummies)
+        outs = [(None,) + tuple(o.shape[1:]) for o in out]
+        return outs[0] if len(outs) == 1 else outs
+
+    # ---- convenience inference ----------------------------------------
+    def predict(self, x, batch_per_thread: int = 32):
+        # cache params + the jitted forward across calls — a fresh jit
+        # closure per call would recompile the graph every predict()
+        if getattr(self, "_predict_cache", None) is None:
+            self._predict_cache = (
+                self.init_params(jax.random.PRNGKey(0), None),
+                jax.jit(lambda p, *a: self.fn(
+                    p, *a, rng=jax.random.PRNGKey(0))))
+        params, fwd = self._predict_cache
+        xs = x if isinstance(x, (tuple, list)) else (x,)
+        outs = []
+        n = len(xs[0])
+        for i in range(0, n, batch_per_thread):
+            batch = [np.asarray(a[i:i + batch_per_thread]) for a in xs]
+            outs.append([np.asarray(o) for o in fwd(params, *batch)])
+    # concatenate per-output across batches
+        cat = [np.concatenate([o[j] for o in outs])
+               for j in range(len(outs[0]))]
+        return cat[0] if len(cat) == 1 else cat
+
+
+class OnnxLoader:
+    """Reference-parity entry (onnx_loader.py:32): load an ONNX model."""
+
+    @staticmethod
+    def from_path(path: str) -> OnnxNet:
+        return OnnxNet(path=path)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> OnnxNet:
+        return OnnxNet(model=load_model(data))
+
+
+def load_onnx(path: str) -> OnnxNet:
+    """Load an ``.onnx`` file as an :class:`OnnxNet` layer."""
+    return OnnxNet(path=path)

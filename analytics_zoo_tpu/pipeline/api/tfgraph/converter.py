@@ -99,51 +99,13 @@ def _norm_tensor_name(name: str) -> Tuple[str, int]:
 
 
 # ---------------------------------------------------------------------------
-# static (host-side numpy) vs traced values
+# static (host-side numpy) vs traced values: shared with the ONNX importer
 
-def _is_static(v) -> bool:
-    return isinstance(v, (np.ndarray, np.generic, int, float, bool))
+from .._convert_util import (ConvertCtx as _Ctx, is_static as _is_static,
+                             np_or_jnp as _nb, require_static as _static,
+                             static_ints as _ints)
 
-
-def _static(v, what: str):
-    """Require a host-static value (shape math); fail with guidance."""
-    if not _is_static(v):
-        raise ValueError(
-            f"{what} must be statically known for XLA (got a traced "
-            "value); keep shape-producing subgraphs free of placeholders")
-    return np.asarray(v)
-
-
-def _ints(v, what: str) -> List[int]:
-    return [int(x) for x in np.atleast_1d(_static(v, what))]
-
-
-def _nb(np_fn, jnp_fn):
-    """Binary/n-ary op that stays in numpy when all args are static."""
-    def h(*args):
-        if all(_is_static(a) for a in args):
-            return np_fn(*args)
-        return jnp_fn(*args)
-    return h
-
-
-# ---------------------------------------------------------------------------
 # op handlers.  signature: handler(ctx, node, args) -> output | tuple
-
-class _Ctx:
-    def __init__(self, params, rng, training):
-        self.params = params
-        self.rng = rng
-        self.training = training
-        self.node_seq = 0
-
-    def next_rng(self):
-        if self.rng is None:
-            raise ValueError(
-                "graph contains random ops (dropout?); pass rng= to the "
-                "converted function")
-        self.node_seq += 1
-        return jax.random.fold_in(self.rng, self.node_seq)
 
 
 def _param(ctx, node):
@@ -285,7 +247,9 @@ def _reduction(jnp_fn, np_fn):
     def h(ctx, node, args):
         x, axes = args
         keep = bool(_attr(node, "keep_dims", _attr(node, "keepdims", False)))
-        ax = tuple(_ints(axes, "reduction axes")) or None
+        # NB: TF reduce over axis=[] is a no-op, NOT reduce-all — keep the
+        # empty tuple (axis=() is the numpy/jnp no-op spelling)
+        ax = tuple(_ints(axes, "reduction axes"))
         if _is_static(x):
             return np_fn(np.asarray(x), axis=ax, keepdims=keep)
         return jnp_fn(x, axis=ax, keepdims=keep)

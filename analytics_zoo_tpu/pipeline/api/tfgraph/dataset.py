@@ -18,7 +18,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ....data.dataset import Dataset, check_batch_divisibility
+from ....data.dataset import check_batch_divisibility
 from ....parallel import mesh as mesh_lib
 
 _COLLECTION = "analytics_zoo_tpu_tfdataset"
@@ -45,23 +45,6 @@ class TFDataset:
         self.val_arrays = ([np.asarray(a) for a in val_arrays]
                            if val_arrays is not None else None)
         self._placeholders: Optional[List[Any]] = None
-        if has_label:
-            x = tuple(self.arrays[:-1])
-            y = self.arrays[-1]
-            self.inner = Dataset(x if len(x) > 1 else x[0], y)
-        else:
-            x = tuple(self.arrays)
-            self.inner = Dataset(x if len(x) > 1 else x[0])
-        if self.val_arrays is not None:
-            if has_label:
-                vx = tuple(self.val_arrays[:-1])
-                self.val_inner: Optional[Dataset] = Dataset(
-                    vx if len(vx) > 1 else vx[0], self.val_arrays[-1])
-            else:
-                vx = tuple(self.val_arrays)
-                self.val_inner = Dataset(vx if len(vx) > 1 else vx[0])
-        else:
-            self.val_inner = None
 
     # -- constructors (reference from_rdd :496 / from_ndarray) ----------
     @classmethod

@@ -152,12 +152,18 @@ class TFNet(Layer):
 
     # ---- convenience inference (reference TFNet predict path) ----------
     def predict(self, x, batch_per_thread: int = 32) -> np.ndarray:
-        params = self.init_params(jax.random.PRNGKey(0), None)
+        # cache params + the jitted forward across calls — a fresh jit
+        # closure per call would recompile the graph every predict()
+        if getattr(self, "_predict_cache", None) is None:
+            # frozen graphs may retain dropout/random nodes (the
+            # reference's TF runtime just executed them at inference);
+            # feed a fixed key
+            self._predict_cache = (
+                self.init_params(jax.random.PRNGKey(0), None),
+                jax.jit(lambda p, *a: self.fn(
+                    p, *a, rng=jax.random.PRNGKey(0))))
+        params, fwd = self._predict_cache
         xs = x if isinstance(x, (tuple, list)) else (x,)
-        # frozen graphs may retain dropout/random nodes (the reference's TF
-        # runtime just executed them at inference); feed a fixed key
-        fwd = jax.jit(
-            lambda p, *a: self.fn(p, *a, rng=jax.random.PRNGKey(0)))
         outs = []
         n = len(xs[0])
         bs = batch_per_thread

@@ -219,12 +219,21 @@ class TFOptimizer:
         import tensorflow as tf
 
         values = self._current_trainable()
-        graph = self.sess.graph
-        with graph.as_default():
-            for name, var in self._trainable_vars.items():
-                ph = tf.compat.v1.placeholder(var.dtype.base_dtype,
-                                              var.shape)
-                self.sess.run(var.assign(ph), feed_dict={ph: values[name]})
+        # placeholders + assign ops are built once and reused: per-call
+        # construction would grow the user's graph on every optimize()
+        # (and fail outright on a finalized graph)
+        if getattr(self, "_assign_cache", None) is None:
+            with self.sess.graph.as_default():
+                cache = {}
+                for name, var in self._trainable_vars.items():
+                    ph = tf.compat.v1.placeholder(var.dtype.base_dtype,
+                                                  var.shape)
+                    cache[name] = (ph, var.assign(ph))
+                self._assign_cache = cache
+        names = list(self._trainable_vars)
+        self.sess.run([self._assign_cache[n][1] for n in names],
+                      feed_dict={self._assign_cache[n][0]: values[n]
+                                 for n in names})
 
 
 class TFPredictor:
