@@ -73,10 +73,21 @@ class ImageBytesToMat(ImageProcessing):
         f = _as_feature(sample)
         raw = f["image"]
         if isinstance(raw, (bytes, bytearray)):
-            if not _HAS_PIL:
-                raise RuntimeError("PIL unavailable for image decode")
-            img = _PILImage.open(io.BytesIO(raw)).convert("RGB")
-            arr = np.asarray(img, dtype=np.float32)[:, :, ::-1]  # RGB->BGR
+            arr = None
+            from ... import native
+            if native.available():
+                try:  # C++ decode (libjpeg/libpng) — the fast path
+                    rgb = native.decode_image(bytes(raw))
+                    arr = rgb[:, :, ::-1].astype(np.float32)  # RGB->BGR
+                except ValueError:
+                    arr = None  # exotic format: PIL fallback below
+            if arr is None:
+                if not _HAS_PIL:
+                    raise RuntimeError(
+                        "no decoder available (native build failed and "
+                        "PIL missing)")
+                img = _PILImage.open(io.BytesIO(raw)).convert("RGB")
+                arr = np.asarray(img, dtype=np.float32)[:, :, ::-1]
             f["original_size"] = arr.shape
             f["image"] = arr
         return f

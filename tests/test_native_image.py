@@ -1,0 +1,193 @@
+"""Native C++ image pipeline tests (decode/resize/normalize + ImageLoader).
+
+Reference analog: the OpenCV-backed image transformer specs; here the
+oracle is PIL (same libjpeg/libpng underneath)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from PIL import Image
+
+from analytics_zoo_tpu import native
+from analytics_zoo_tpu.data.image_loader import (ImageLoader,
+                                                 list_image_files)
+
+
+def make_png(arr) -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def make_jpeg(arr, quality=95) -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=quality)
+    return buf.getvalue()
+
+
+rs = np.random.RandomState(0)
+IMG = rs.randint(0, 255, (37, 53, 3), dtype=np.uint8)
+
+
+@pytest.fixture(scope="module")
+def nat():
+    if not native.available():
+        pytest.skip(f"native build unavailable: {native.build_error()}")
+    return native
+
+
+class TestDecode:
+    def test_png_lossless_exact(self, nat):
+        out = nat.decode_image(make_png(IMG))
+        np.testing.assert_array_equal(out, IMG)
+
+    def test_jpeg_matches_pil(self, nat):
+        raw = make_jpeg(IMG)
+        out = nat.decode_image(raw)
+        pil = np.asarray(Image.open(io.BytesIO(raw)).convert("RGB"))
+        # same libjpeg underneath: tolerate ±2 for IDCT variation
+        assert np.abs(out.astype(int) - pil.astype(int)).max() <= 2
+
+    def test_grayscale_jpeg_promoted_to_rgb(self, nat):
+        gray = rs.randint(0, 255, (20, 24), dtype=np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(gray, mode="L").save(buf, "JPEG", quality=95)
+        out = nat.decode_image(buf.getvalue())
+        assert out.shape == (20, 24, 3)
+        assert np.abs(out[:, :, 0].astype(int) - out[:, :, 1].astype(int)
+                      ).max() == 0
+
+    def test_garbage_raises(self, nat):
+        with pytest.raises(ValueError):
+            nat.decode_image(b"not an image at all")
+
+    def test_upsample_matches_pil(self, nat):
+        # on upsampling PIL's bilinear filter degenerates to classic
+        # sample-based bilinear, so the two conventions agree
+        out = nat.resize_bilinear(IMG, (74, 106))
+        pil = np.asarray(Image.fromarray(IMG).resize(
+            (106, 74), Image.BILINEAR))
+        assert np.abs(out.astype(int) - pil.astype(int)).max() <= 2
+
+    def test_downsample_matches_numpy_reference(self, nat):
+        # downsample: OpenCV-style sample-based bilinear (PIL antialiases
+        # instead) — oracle is a numpy half-pixel-center implementation
+        dh, dw = 16, 24
+        sh, sw = IMG.shape[:2]
+        fy = np.clip((np.arange(dh) + 0.5) * sh / dh - 0.5, 0, None)
+        fx = np.clip((np.arange(dw) + 0.5) * sw / dw - 0.5, 0, None)
+        y0 = fy.astype(int)
+        x0 = fx.astype(int)
+        y1 = np.minimum(y0 + 1, sh - 1)
+        x1 = np.minimum(x0 + 1, sw - 1)
+        wy = (fy - y0)[:, None, None]
+        wx = (fx - x0)[None, :, None]
+        img = IMG.astype(np.float64)
+        top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+        bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+        ref = (top * (1 - wy) + bot * wy + 0.5).astype(np.uint8)
+        out = nat.resize_bilinear(IMG, (dh, dw))
+        assert np.abs(out.astype(int) - ref.astype(int)).max() <= 1
+
+
+class TestBatch:
+    def test_batch_decode_normalize(self, nat):
+        blobs = [make_png(IMG), make_png(IMG[::-1].copy())]
+        mean, std = [100.0, 110.0, 120.0], [50.0, 55.0, 60.0]
+        out = nat.decode_resize_normalize_batch(
+            blobs, (37, 53), mean=mean, std=std, num_threads=2)
+        want0 = (IMG.astype(np.float32) - mean) / std
+        np.testing.assert_allclose(out[0], want0, rtol=1e-5, atol=1e-5)
+        assert out.shape == (2, 37, 53, 3)
+
+    def test_batch_resize(self, nat):
+        out = nat.decode_resize_normalize_batch(
+            [make_png(IMG)] * 3, (16, 16), num_threads=3)
+        ref = nat.resize_bilinear(IMG, (16, 16)).astype(np.float32)
+        np.testing.assert_allclose(out[1], ref, atol=1.0)
+
+    def test_batch_error_modes(self, nat):
+        blobs = [make_png(IMG), b"garbage"]
+        with pytest.raises(ValueError, match="1/2"):
+            nat.decode_resize_normalize_batch(blobs, (8, 8))
+        out = nat.decode_resize_normalize_batch(blobs, (8, 8),
+                                                errors="zero")
+        assert np.all(out[1] == 0) and not np.all(out[0] == 0)
+
+
+class TestImageLoader:
+    @pytest.fixture()
+    def folder(self, tmp_path):
+        for cls_name, color in [("cat", 60), ("dog", 200)]:
+            d = tmp_path / cls_name
+            d.mkdir()
+            for i in range(5):
+                arr = np.full((20 + i, 30, 3), color, np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.png")
+        return str(tmp_path)
+
+    def test_list_files_with_labels(self, folder):
+        files, labels, names = list_image_files(folder, with_label=True)
+        assert len(files) == 10
+        assert names == ["cat", "dog"]
+        assert labels.tolist() == [0] * 5 + [1] * 5
+
+    def test_iteration_and_normalization(self, folder):
+        loader = ImageLoader.from_folder(
+            folder, batch_size=4, size=(16, 16), scale=1 / 255.0)
+        batches = list(loader)
+        assert [b[0].shape[0] for b in batches] == [4, 4, 2]
+        imgs, labels = batches[0]
+        assert imgs.shape == (4, 16, 16, 3)
+        assert imgs.max() <= 1.0
+        # cat images are uniform gray 60
+        np.testing.assert_allclose(imgs[0], 60 / 255.0, atol=1e-2)
+
+    def test_shuffle_epochs_differ(self, folder):
+        loader = ImageLoader.from_folder(folder, batch_size=10,
+                                         size=(8, 8), shuffle=True, seed=1)
+        _, y1 = next(iter(loader))  # epoch 0 (seed 1)
+        _, y2 = next(iter(loader))  # epoch 1 (seed 2)
+        assert sorted(y1.tolist()) == sorted(y2.tolist())
+        # deterministic given seed=1: the per-epoch reseed must actually
+        # change the order
+        assert y1.tolist() != y2.tolist()
+
+    def test_abandoned_iteration_stops_producer(self, folder):
+        import threading
+        before = threading.active_count()
+        loader = ImageLoader.from_folder(folder, batch_size=2, size=(8, 8),
+                                         prefetch=1)
+        it = iter(loader)
+        next(it)
+        it.close()  # abandon mid-epoch
+        deadline = 50
+        while threading.active_count() > before and deadline:
+            import time
+            time.sleep(0.1)
+            deadline -= 1
+        assert threading.active_count() <= before, "producer thread leaked"
+
+    def test_as_dataset(self, folder):
+        ds = ImageLoader.from_folder(folder, batch_size=3,
+                                     size=(8, 8)).as_dataset()
+        assert ds.size == 10
+
+    def test_drop_remainder(self, folder):
+        loader = ImageLoader.from_folder(folder, batch_size=4, size=(8, 8),
+                                         drop_remainder=True)
+        assert loader.steps_per_epoch() == 2
+        assert [b[0].shape[0] for b in loader] == [4, 4]
+
+
+class TestTransformIntegration:
+    def test_bytes_to_mat_uses_native(self):
+        from analytics_zoo_tpu.feature.image.transforms import (
+            ImageBytesToMat)
+        f = ImageBytesToMat().apply(make_png(IMG))
+        # BGR float output, per reference convention
+        np.testing.assert_allclose(f["image"][:, :, ::-1],
+                                   IMG.astype(np.float32))
