@@ -1,0 +1,231 @@
+"""Post-training int8 quantization for inference.
+
+Parity surface: the reference ships ``*-quantize`` model variants backed by
+BigDL's 8-bit "local quantization windows" scheme (docs/docs/wp-bigdl.md:
+186-196: up to 2x inference speedup, 4x model-size reduction, <0.1%
+accuracy drop; registry names ObjectDetectionConfig.scala:33-44).
+
+TPU-native design: weights are quantized **per output channel** (symmetric
+absmax int8) ahead of time; activations are quantized **per tensor,
+dynamically** inside the traced function.  The matmul/conv itself runs in
+int8 with int32 accumulation via ``preferred_element_type`` — XLA lowers
+that onto the MXU's native int8 path — and one fused rescale
+(x_scale * w_scale[channel]) returns to float.  Everything stays inside
+one jit, so quantize/compute/dequantize fuse with neighbouring ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.graph import GraphModule, InputLayer, Variable
+from ..core.module import Layer, Params, register_layer
+
+_EPS = 1e-12
+
+
+# ---------------------------------------------------------------------------
+# primitives
+
+def quantize_per_channel(w, out_axis: int = -1) -> Tuple[jnp.ndarray,
+                                                         jnp.ndarray]:
+    """Symmetric absmax int8 quantization per output channel.
+
+    Returns (w_q int8 same shape, scale float32 of shape (out_channels,)):
+    ``w ≈ w_q * scale`` broadcast along ``out_axis``.
+    """
+    w = jnp.asarray(w, jnp.float32)
+    axis = out_axis % w.ndim
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    absmax = jnp.max(jnp.abs(w), axis=red)
+    scale = jnp.maximum(absmax / 127.0, _EPS)
+    bshape = tuple(w.shape[i] if i == axis else 1 for i in range(w.ndim))
+    wq = jnp.clip(jnp.round(w / jnp.reshape(scale, bshape)), -127, 127)
+    return wq.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dynamic_quantize(x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-tensor dynamic activation quantization (absmax, symmetric).
+
+    Traced: the scale is computed on-device per batch, so no calibration
+    pass is needed (BigDL's "local quantization window" played the same
+    role per-block)."""
+    x = jnp.asarray(x)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, _EPS).astype(
+        jnp.float32)
+    xq = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return xq, scale
+
+
+def int8_matmul(x, w_q, w_scale):
+    """``x @ dequant(w_q)`` computed in int8 with int32 accumulation."""
+    xq, xs = dynamic_quantize(x)
+    acc = lax.dot_general(
+        xq, w_q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (xs * w_scale)
+
+
+def int8_conv(x_cl, w_q, w_scale, strides, padding, rhs_dilation,
+              dimension_numbers):
+    """Channels-last conv in int8 with int32 accumulation; returns float32
+    with the per-output-channel rescale applied."""
+    xq, xs = dynamic_quantize(x_cl)
+    acc = lax.conv_general_dilated(
+        xq, w_q, window_strides=strides, padding=padding,
+        rhs_dilation=rhs_dilation, dimension_numbers=dimension_numbers,
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (xs * w_scale)
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers
+
+class _QuantizedLayer(Layer):
+    """Base: holds pre-converted arrays; init returns them verbatim."""
+
+    def __init__(self, src: Layer, initial: Params):
+        # reuse the source layer's name so params/state keys line up in
+        # the rebuilt graph
+        super().__init__(name=src.name)
+        self.src = src
+        self._initial = dict(initial)
+        self.trainable = False  # int8 weights are not a gradient surface
+
+    def init_params(self, rng, input_shape):
+        return dict(self._initial)
+
+    def compute_output_shape(self, input_shape):
+        return self.src.compute_output_shape(input_shape)
+
+    def get_config(self):
+        raise NotImplementedError(
+            "quantized models are an inference-time artifact and are not "
+            "serialized; save the float model and re-quantize after load")
+
+
+@register_layer
+class QuantizedDense(_QuantizedLayer):
+    """int8 inference version of Dense (y = act(x @ W + b))."""
+
+    @classmethod
+    def from_layer(cls, dense, params: Params) -> "QuantizedDense":
+        wq, scale = quantize_per_channel(params["W"], out_axis=-1)
+        initial = {"Wq": wq, "w_scale": scale}
+        if dense.bias:
+            initial["b"] = jnp.asarray(params["b"], jnp.float32)
+        return cls(dense, initial)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        y = int8_matmul(inputs, params["Wq"], params["w_scale"])
+        if self.src.bias:
+            y = y + params["b"]
+        if self.src.activation is not None:
+            y = self.src.activation(y)
+        return y
+
+
+@register_layer
+class QuantizedConv(_QuantizedLayer):
+    """int8 inference version of the standard _ConvND convolutions."""
+
+    @classmethod
+    def from_layer(cls, conv, params: Params) -> "QuantizedConv":
+        wq, scale = quantize_per_channel(params["W"], out_axis=-1)
+        initial = {"Wq": wq, "w_scale": scale}
+        if conv.bias:
+            initial["b"] = jnp.asarray(params["b"], jnp.float32)
+        return cls(conv, initial)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        from ..pipeline.api.keras.layers.convolutional import _DN, _padding
+        src = self.src
+        x = src._to_cl(inputs)
+        pad = _padding(src.border_mode, src.rank)
+        if src.border_mode == "causal":  # Conv1D only
+            left = src.dilation[0] * (src.kernel_size[0] - 1)
+            x = jnp.pad(x, ((0, 0), (left, 0), (0, 0)))
+            pad = "VALID"
+        y = int8_conv(x, params["Wq"], params["w_scale"],
+                      strides=src.subsample, padding=pad,
+                      rhs_dilation=src.dilation,
+                      dimension_numbers=_DN[src.rank])
+        if src.bias:
+            y = y + params["b"]
+        if src.activation is not None:
+            y = src.activation(y)
+        return src._from_cl(y)
+
+
+# ---------------------------------------------------------------------------
+# graph transformation
+
+def _quantizable(layer: Layer, params: Params) -> Optional[type]:
+    """Return the quantized wrapper class for supported layers.
+
+    Supported: Dense and plain _ConvND convolutions *that did not override
+    the compute path* (subclasses with custom call/_conv — e.g. separable
+    or transposed variants — are left in float)."""
+    from ..pipeline.api.keras.layers.convolutional import _ConvND
+    from ..pipeline.api.keras.layers.core import Dense
+    if "W" not in params or not jnp.issubdtype(
+            jnp.asarray(params["W"]).dtype, jnp.floating):
+        return None
+    if isinstance(layer, Dense) and type(layer).call is Dense.call:
+        return QuantizedDense
+    if isinstance(layer, _ConvND) and type(layer).call is _ConvND.call \
+            and type(layer)._conv is _ConvND._conv:
+        return QuantizedConv
+    return None
+
+
+def quantize_graph(graph: GraphModule, params: Params,
+                   state: Optional[Dict] = None
+                   ) -> Tuple[GraphModule, Params, Dict]:
+    """Rebuild ``graph`` with Dense/Conv layers swapped for int8 wrappers.
+
+    Returns (new_graph, new_params, state): params of untouched layers are
+    carried over under their original keys; quantized layers contribute
+    their int8 weights + scales (4x smaller than the float originals).
+    """
+    new_of: Dict[int, Variable] = {}
+    layer_map: Dict[int, Layer] = {}
+    new_params: Params = {}
+    for v in graph.nodes:
+        if v.layer is None or isinstance(v.layer, InputLayer):
+            new_of[v.node_id] = v  # share input nodes
+            continue
+        layer = v.layer
+        if id(layer) not in layer_map:
+            p = params.get(layer.name, {})
+            qcls = _quantizable(layer, p)
+            if qcls is not None:
+                qlayer = qcls.from_layer(layer, p)
+                layer_map[id(layer)] = qlayer
+                new_params[qlayer.name] = qlayer._initial
+            else:
+                layer_map[id(layer)] = layer
+                if p:
+                    new_params[layer.name] = p
+        nl = layer_map[id(layer)]
+        ins = [new_of[p.node_id] for p in v.inputs]
+        new_of[v.node_id] = Variable(nl, ins, v.shape)
+    inputs = list(graph.input_vars)
+    outputs = [new_of[o.node_id] for o in graph.output_vars]
+    single = graph.single_output
+    new_graph = GraphModule(inputs,
+                            outputs[0] if single else outputs,
+                            name=f"{graph.name}_int8")
+    return new_graph, new_params, dict(state or {})
+
+
+def quantized_size_bytes(params: Params) -> int:
+    """Total serialized byte size of a params tree (reporting helper)."""
+    return int(sum(np.asarray(p).nbytes
+                   for p in jax.tree_util.tree_leaves(params)))

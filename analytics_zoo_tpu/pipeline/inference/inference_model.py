@@ -57,20 +57,28 @@ class InferenceModel:
         self._graph = None
 
     # ---- loading (reference load/loadCaffe/loadTF surface) ----
-    def load(self, model_path: str, weight_path: Optional[str] = None):
+    def load(self, model_path: str, weight_path: Optional[str] = None,
+             quantize: Optional[bool] = None):
         """Load a model saved with save_model (the framework's own
-        format; reference ``load`` reads BigDL format)."""
+        format; reference ``load`` reads BigDL format).  ``quantize=True``
+        serves the int8 inference variant (reference loads ``*-quantize``
+        models)."""
         from ..api.keras.engine import KerasNet
         net = KerasNet.load_model(model_path)
         trainer = net.ensure_inference_ready()
         if weight_path is not None:
             trainer.load_weights(weight_path)
-        self._attach(net.to_graph(), trainer.state.params,
-                     trainer.state.model_state)
-        return self
+        return self.load_keras_net(net, quantize=quantize)
 
-    def load_keras_net(self, net):
+    def load_keras_net(self, net, quantize: Optional[bool] = None):
         """Serve an in-memory KerasNet/ZooModel."""
+        if quantize is None:
+            # reload() must not silently flip a quantized handle back to
+            # float: default to however this handle was last loaded
+            quantize = getattr(self, "_quantize_flag", False)
+        self._quantize_flag = bool(quantize)
+        if quantize:
+            net = net.quantize()
         trainer = net.ensure_inference_ready()
         self._attach(net.to_graph(), trainer.state.params,
                      trainer.state.model_state)
@@ -105,8 +113,11 @@ class InferenceModel:
 
         self._predict_fn = predict_fn
 
-    def reload(self, model_path: str, weight_path: Optional[str] = None):
-        return self.load(model_path, weight_path)
+    def reload(self, model_path: str, weight_path: Optional[str] = None,
+               quantize: Optional[bool] = None):
+        """Hot-swap the served model; keeps the previous quantize mode
+        unless overridden."""
+        return self.load(model_path, weight_path, quantize=quantize)
 
     # ---- prediction (AbstractInferenceModel.predict:112-126) ----
     def predict(self, inputs) -> Any:

@@ -1,0 +1,183 @@
+"""int8 post-training quantization tests (reference: *-quantize model
+variants, BigDL 8-bit local-quantization scheme wp-bigdl.md:186-196)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.quantize import (
+    dynamic_quantize, int8_matmul, quantize_graph, quantize_per_channel,
+    quantized_size_bytes)
+
+
+class TestPrimitives:
+    def test_per_channel_round_trip(self):
+        rs = np.random.RandomState(0)
+        w = rs.randn(16, 8).astype(np.float32) * np.linspace(
+            0.1, 3.0, 8)  # very different per-channel ranges
+        wq, scale = quantize_per_channel(w, out_axis=-1)
+        assert wq.dtype == jnp.int8 and scale.shape == (8,)
+        deq = np.asarray(wq, np.float32) * np.asarray(scale)
+        # per-channel: relative error bounded by 1/127 of channel absmax
+        err = np.abs(deq - w).max(axis=0)
+        bound = np.abs(w).max(axis=0) / 127.0 + 1e-6
+        assert np.all(err <= bound)
+
+    def test_dynamic_quantize(self):
+        x = jnp.asarray([[-3.0, 0.0, 1.5]])
+        xq, s = dynamic_quantize(x)
+        assert xq.dtype == jnp.int8
+        np.testing.assert_allclose(np.asarray(xq, np.float32) * s, x,
+                                   atol=float(s))
+        assert int(np.abs(np.asarray(xq)).max()) == 127
+
+    def test_int8_matmul_close_to_float(self):
+        rs = np.random.RandomState(1)
+        x = rs.randn(4, 64).astype(np.float32)
+        w = rs.randn(64, 32).astype(np.float32)
+        wq, ws = quantize_per_channel(w)
+        got = np.asarray(int8_matmul(jnp.asarray(x), wq, ws))
+        want = x @ w
+        # int8 dynamic quantization: ~1% relative error on random gaussians
+        rel = np.abs(got - want).max() / np.abs(want).max()
+        assert rel < 0.03, rel
+
+    def test_int8_matmul_under_jit_and_grad_free(self):
+        rs = np.random.RandomState(2)
+        w = rs.randn(16, 4).astype(np.float32)
+        wq, ws = quantize_per_channel(w)
+        f = jax.jit(lambda x: int8_matmul(x, wq, ws))
+        out = f(jnp.asarray(rs.randn(2, 16), jnp.float32))
+        assert out.shape == (2, 4) and out.dtype == jnp.float32
+
+
+def _trained_mlp():
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers.core import Dense
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 10).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    model = Sequential()
+    model.add(Dense(16, activation="relu", input_shape=(10,)))
+    model.add(Dense(2, activation="softmax"))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=16, nb_epoch=10)
+    return model, x, y
+
+
+class TestModelQuantization:
+    def test_quantized_model_matches_float(self):
+        model, x, y = _trained_mlp()
+        float_preds = model.predict(x, batch_size=32)
+        qmodel = model.quantize()
+        q_preds = qmodel.predict(x, batch_size=32)
+        assert q_preds.shape == float_preds.shape
+        # softmax outputs stay close; argmax should rarely flip
+        agree = (np.argmax(q_preds, -1) == np.argmax(float_preds, -1)
+                 ).mean()
+        assert agree >= 0.95, agree
+        np.testing.assert_allclose(q_preds, float_preds, atol=0.08)
+
+    def test_quantized_params_are_smaller(self):
+        model, _, _ = _trained_mlp()
+        t = model.ensure_inference_ready()
+        fsize = quantized_size_bytes(t.state.params)
+        _, qparams, _ = quantize_graph(model.to_graph(), t.state.params,
+                                       t.state.model_state)
+        qsize = quantized_size_bytes(qparams)
+        assert qsize < fsize * 0.45  # ~4x reduction on the weight matrices
+
+    def test_quantized_conv_model(self):
+        from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.layers.convolutional \
+            import Convolution2D
+        from analytics_zoo_tpu.pipeline.api.keras.layers.core import (
+            Dense, Flatten)
+
+        rs = np.random.RandomState(3)
+        model = Sequential()
+        model.add(Convolution2D(4, 3, 3, activation="relu",
+                                border_mode="same",
+                                input_shape=(8, 8, 3)))
+        model.add(Flatten())
+        model.add(Dense(5, activation="softmax"))
+        x = rs.randn(6, 8, 8, 3).astype(np.float32)
+        float_preds = model.predict(x)
+        q = model.quantize()
+        q_preds = q.predict(x)
+        np.testing.assert_allclose(q_preds, float_preds, atol=0.08)
+
+    def test_unsupported_layers_stay_float(self):
+        from analytics_zoo_tpu.ops.quantize import _quantizable
+        from analytics_zoo_tpu.pipeline.api.keras.layers.convolutional \
+            import Deconvolution2D, SeparableConvolution2D
+        assert _quantizable(Deconvolution2D(4), {"W": np.ones((3, 3, 4, 4),
+                                                             np.float32)}) \
+            is None
+        assert _quantizable(SeparableConvolution2D(4),
+                            {"W": np.ones((3, 3, 4, 4), np.float32)}) is None
+
+    def test_quantized_model_not_serializable(self):
+        model, _, _ = _trained_mlp()
+        q = model.quantize()
+        with pytest.raises(NotImplementedError, match="re-quantize"):
+            q.get_config()
+
+
+class TestRegistryAndServing:
+    def test_image_classifier_quantize_name(self):
+        from analytics_zoo_tpu.models.image.classification import (
+            ImageClassifier)
+        m = ImageClassifier("squeezenet-quantize",
+                            input_shape=(32, 32, 3), num_classes=4)
+        x = np.random.RandomState(0).randn(2, 32, 32, 3).astype(np.float32)
+        preds = m.predict(x, batch_size=2)
+        assert preds.shape == (2, 4)
+        assert m._quantized_net is not None  # int8 path was built
+
+    def test_quantized_cache_invalidated_on_weight_change(self):
+        from analytics_zoo_tpu.models.image.classification import (
+            ImageClassifier)
+        m = ImageClassifier("squeezenet-quantize",
+                            input_shape=(32, 32, 3), num_classes=4)
+        x = np.random.RandomState(0).randn(2, 32, 32, 3).astype(np.float32)
+        p1 = m.predict(x, batch_size=2)
+        first_cache = m._quantized_net
+        assert first_cache is not None
+        # mutate weights: compile with a different seed reinitializes
+        m.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  seed=7)
+        assert m._quantized_net is None  # cache dropped
+        p2 = m.predict(x, batch_size=2)
+        assert m._quantized_net is not first_cache
+        assert not np.allclose(p1, p2)  # new weights actually served
+
+    def test_inference_model_reload_keeps_quantize(self, tmp_path):
+        from analytics_zoo_tpu.pipeline.inference.inference_model import (
+            InferenceModel)
+        model, x, _ = _trained_mlp()
+        path = str(tmp_path / "m")
+        model.save_model(path)
+        im = InferenceModel().load(path, quantize=True)
+        assert im._quantize_flag is True
+        im.reload(path)  # no explicit flag: must stay int8
+        assert im._quantize_flag is True
+
+    def test_image_classifier_unknown_name(self):
+        from analytics_zoo_tpu.models.image.classification import (
+            ImageClassifier)
+        with pytest.raises(ValueError, match="quantize"):
+            ImageClassifier("no-such-net-quantize")
+
+    def test_inference_model_quantize_flag(self):
+        from analytics_zoo_tpu.pipeline.inference.inference_model import (
+            InferenceModel)
+        model, x, _ = _trained_mlp()
+        im = InferenceModel().load_keras_net(model, quantize=True)
+        out = np.asarray(im.predict(x[:8]))
+        ref = model.predict(x[:8])
+        np.testing.assert_allclose(out, ref, atol=0.08)
