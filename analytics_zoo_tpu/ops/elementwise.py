@@ -94,7 +94,13 @@ def _as_variable(x):
     return constant(x)
 
 
-def _apply(op: str, variables, **op_kwargs) -> Variable:
+def _apply(op: str, variables, **op_kwargs):
+    # polymorphic like the reference's AutoGrad object: on Variables the
+    # op becomes a graph node; on plain arrays it evaluates eagerly, so
+    # autograd-style expressions also work inside CustomLoss/Lambda
+    # functions that receive jnp arrays
+    if not builtins.any(isinstance(v, Variable) for v in variables):
+        return _OPS[op]([jnp.asarray(v) for v in variables], **op_kwargs)
     vs = [_as_variable(v) for v in variables]
     layer = OpLayer(op=op, op_kwargs=op_kwargs)
     return Variable.from_layer(layer, vs if len(vs) > 1 else vs[0])

@@ -144,14 +144,10 @@ class QuantizedConv(_QuantizedLayer):
         return cls(conv, initial)
 
     def call(self, params, state, inputs, training=False, rng=None):
-        from ..pipeline.api.keras.layers.convolutional import _DN, _padding
+        from ..pipeline.api.keras.layers.convolutional import _DN
         src = self.src
         x = src._to_cl(inputs)
-        pad = _padding(src.border_mode, src.rank)
-        if src.border_mode == "causal":  # Conv1D only
-            left = src.dilation[0] * (src.kernel_size[0] - 1)
-            x = jnp.pad(x, ((0, 0), (left, 0), (0, 0)))
-            pad = "VALID"
+        x, pad = src._resolve_padding(x)
         y = int8_conv(x, params["Wq"], params["w_scale"],
                       strides=src.subsample, padding=pad,
                       rhs_dilation=src.dilation,

@@ -177,9 +177,13 @@ class KerasNet(Layer):
                    name=f"{self.name}_int8")
         # build the inference trainer and adopt directly — going through
         # ensure_inference_ready would materialize a throwaway full init
-        # that adopt_weights immediately overwrites
+        # that adopt_weights immediately overwrites.  Mesh/strategy carry
+        # over: a model sharded because it does not fit replicated must
+        # not come back fully replicated as int8.
         qm.trainer = Trainer(qm.to_graph(), None,
-                             optimizers_lib.get("sgd"))
+                             optimizers_lib.get("sgd"),
+                             mesh=trainer.mesh,
+                             strategy=trainer.strategy)
         qm._inference_only = True
         qm.trainer.adopt_weights(qparams, qstate)
         qm._weights_loaded = True

@@ -92,12 +92,19 @@ class _ConvND(Layer):
             params["b"] = jnp.zeros((self.nb_filter,))
         return params
 
-    def _conv(self, x, w):
+    def _resolve_padding(self, x):
+        """(possibly pre-padded x, lax padding spec) for this conv's
+        border mode — shared with the int8 inference path so float and
+        quantized convs cannot drift."""
         pad = _padding(self.border_mode, self.rank)
         if self.border_mode == "causal":  # Conv1D only
             left = self.dilation[0] * (self.kernel_size[0] - 1)
             x = jnp.pad(x, ((0, 0), (left, 0), (0, 0)))
             pad = "VALID"
+        return x, pad
+
+    def _conv(self, x, w):
+        x, pad = self._resolve_padding(x)
         return lax.conv_general_dilated(
             x, w, window_strides=self.subsample, padding=pad,
             rhs_dilation=self.dilation,

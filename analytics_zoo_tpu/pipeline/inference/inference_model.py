@@ -75,7 +75,13 @@ class InferenceModel:
         if quantize is None:
             # reload() must not silently flip a quantized handle back to
             # float: default to however this handle was last loaded
-            quantize = getattr(self, "_quantize_flag", False)
+            quantize = getattr(self, "_quantize_flag", None)
+        if quantize is None:
+            # honor the registry's '<arch>-quantize' naming convention
+            # (a saved ImageClassifier('resnet-50-quantize') must serve
+            # int8 without an explicit flag)
+            name = getattr(net, "hyper", {}).get("model_name", "")
+            quantize = isinstance(name, str) and name.endswith("-quantize")
         self._quantize_flag = bool(quantize)
         if quantize:
             net = net.quantize()

@@ -14,6 +14,7 @@ writes, which is the failure-recovery story SURVEY §5 prescribes for SPMD
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import re
@@ -63,13 +64,28 @@ def async_save(directory: str, tag: Any, tree, meta: Optional[dict] = None):
         target=save_checkpoint, args=(directory, tag, host_tree),
         kwargs={"meta": meta}, daemon=True)
     t.start()
-    _PENDING.append(t)
+    _PENDING.append((os.path.abspath(directory), t))
     return t
 
 
-def wait_pending():
+def wait_pending(directory: Optional[str] = None):
+    """Join in-flight writers — all of them, or only those targeting
+    ``directory`` (so one trainer's fit never blocks on another
+    trainer's multi-GB snapshot)."""
+    want = None if directory is None else os.path.abspath(directory)
+    remaining = []
     while _PENDING:
-        _PENDING.pop().join()
+        d, t = _PENDING.pop()
+        if want is None or d == want:
+            t.join()
+        else:
+            remaining.append((d, t))
+    _PENDING.extend(remaining)
+
+
+# daemon writer threads die with the interpreter; without this a short
+# script can exit before its last epoch checkpoint finishes writing
+atexit.register(wait_pending)
 
 
 def latest_tag(directory: str) -> Optional[str]:
@@ -77,6 +93,8 @@ def latest_tag(directory: str) -> Optional[str]:
         return None
     tags = []
     for f in os.listdir(directory):
+        if f.endswith(".tmp.npz"):  # in-flight/aborted atomic write
+            continue
         m = re.match(r"ckpt_(.+)\.npz$", f)
         if m:
             tags.append(m.group(1))

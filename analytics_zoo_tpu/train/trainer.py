@@ -33,6 +33,7 @@ from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
 from . import triggers as trigger_lib
 from .checkpoint import async_save
+from .checkpoint import wait_pending as checkpoint_lib_wait_pending
 from .summary import TrainSummary, ValidationSummary
 
 
@@ -328,6 +329,10 @@ class Trainer:
                 async_save(self._ckpt_path, f"epoch{st.epoch}",
                            st.as_tree(),
                            meta={"step": st.step, "epoch": st.epoch})
+        if self._ckpt_path:
+            # fit returning means "checkpoints are on disk" — join the
+            # async writers so callers can immediately restore
+            checkpoint_lib_wait_pending(self._ckpt_path)
         return history
 
     # ------------------------------------------------------------------

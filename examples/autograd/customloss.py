@@ -1,0 +1,43 @@
+"""CustomLoss from a Variable expression.
+
+Reference analog: pyzoo/zoo/examples/autograd/customloss.py — define mean
+absolute error as a Variable-graph over (y_true, y_pred) and train with it.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu.pipeline.api import autograd as A
+    from analytics_zoo_tpu.pipeline.api.autograd import CustomLoss
+    from analytics_zoo_tpu.core.graph import Input
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers.core import Dense
+
+    # the reference builds the loss graph from Input variables
+    y_true = Input((2,), name="y_true")
+    y_pred = Input((2,), name="y_pred")
+    expr = A.mean(A.abs(y_true - y_pred), axis=1)
+    mae = CustomLoss.from_variables(y_true, y_pred, expr)
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(256, 3).astype(np.float32)
+    w = np.array([[1.0, -1.0], [0.5, 2.0], [-0.3, 0.1]], np.float32)
+    y = x @ w
+
+    model = Sequential()
+    model.add(Dense(2, input_shape=(3,)))
+    model.compile(optimizer="sgd", loss=mae)
+    model.fit(x, y, batch_size=32, nb_epoch=args.epochs)
+    print("final train MAE:",
+          float(np.mean(np.abs(model.predict(x) - y))))
+
+
+if __name__ == "__main__":
+    main()

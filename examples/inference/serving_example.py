@@ -1,0 +1,52 @@
+"""Serving with InferenceModel (concurrent, optionally int8).
+
+Reference analog: the POJO serving API + web-service-sample
+(AbstractInferenceModel.java:30-148): load once, predict from many threads.
+"""
+
+import argparse
+import threading
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--quantize", action="store_true")
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers.core import Dense
+    from analytics_zoo_tpu.pipeline.inference.inference_model import (
+        InferenceModel)
+
+    rs = np.random.RandomState(0)
+    model = Sequential()
+    model.add(Dense(32, activation="relu", input_shape=(16,)))
+    model.add(Dense(4, activation="softmax"))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    model.fit(rs.rand(128, 16).astype(np.float32),
+              rs.randint(0, 4, 128), batch_size=32, nb_epoch=1)
+
+    served = InferenceModel(supported_concurrent_num=args.concurrency)
+    served.load_keras_net(model, quantize=args.quantize)
+
+    results = {}
+
+    def client(i):
+        x = rs.rand(8, 16).astype(np.float32)
+        results[i] = np.asarray(served.predict(x))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(args.concurrency * 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    print(f"served {len(results)} concurrent requests; "
+          f"output shape {results[0].shape}; quantized={args.quantize}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Evaluate a trained LeNet from a checkpoint directory.
+
+Reference analog: pyzoo/zoo/examples/tensorflow/distributed_training/
+evaluate_lenet.py."""
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint", required=True,
+                    help="directory written by train_lenet --checkpoint")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--samples", type=int, default=256)
+    args = ap.parse_args()
+
+    from train_lenet import build_lenet, synthetic_mnist
+
+    model = build_lenet()
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.load_weights(args.checkpoint)
+    x, y = synthetic_mnist(args.samples, seed=1)
+    print("evaluation:", model.evaluate(x, y, batch_size=args.batch_size))
+
+
+if __name__ == "__main__":
+    main()

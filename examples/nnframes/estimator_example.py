@@ -1,0 +1,46 @@
+"""NNEstimator fit/transform over a dataframe.
+
+Reference analog: nnframes examples (zoo/.../examples/nnframes/: train an
+estimator on a DataFrame, transform appends a prediction column).  The
+dataframe here is pandas — the per-host stand-in for Spark DataFrames.
+"""
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers.core import Dense
+    from analytics_zoo_tpu.pipeline.estimator.nn_estimator import (
+        NNClassifier)
+
+    rs = np.random.RandomState(0)
+    n = 512
+    feats = rs.rand(n, 6).astype(np.float32)
+    labels = (feats.sum(axis=1) > 3).astype(np.float32)
+    df = pd.DataFrame({"features": list(feats), "label": labels})
+
+    model = Sequential()
+    model.add(Dense(16, activation="relu", input_shape=(6,)))
+    model.add(Dense(2, activation="softmax"))
+
+    clf = (NNClassifier(model, "sparse_categorical_crossentropy")
+           .set_batch_size(64)
+           .set_max_epoch(args.epochs)
+           .set_learning_rate(1e-2))
+    nn_model = clf.fit(df)
+    out = nn_model.transform(df)
+    acc = float((out["prediction"] == df["label"]).mean())
+    print(f"transform accuracy: {acc:.3f}")
+    print(out.head())
+
+
+if __name__ == "__main__":
+    main()

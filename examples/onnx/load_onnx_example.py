@@ -1,0 +1,55 @@
+"""Import an ONNX model and serve/fine-tune it.
+
+Reference analog: the ONNX loader path (pyzoo/zoo/pipeline/api/onnx).
+Builds a small ONNX file programmatically (the ``onnx`` package is not
+required — the framework carries its own codec), then loads and runs it.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def build_onnx_file(path: str):
+    from analytics_zoo_tpu.pipeline.api.onnx import proto as P
+
+    rs = np.random.RandomState(0)
+    w1 = (rs.randn(8, 16) * 0.3).astype(np.float32)
+    w2 = (rs.randn(16, 4) * 0.3).astype(np.float32)
+    nodes = [
+        P.make_node("Gemm", ["x", "w1"], ["h"]),
+        P.make_node("Relu", ["h"], ["hr"]),
+        P.make_node("Gemm", ["hr", "w2"], ["logits"]),
+        P.make_node("Softmax", ["logits"], ["y"], axis=-1),
+    ]
+    graph = P.make_graph(
+        nodes, "mlp", [P.make_value_info("x", ("N", 8))],
+        [P.make_value_info("y", ("N", 4))],
+        initializer=[P.numpy_to_tensor(w1, "w1"),
+                     P.numpy_to_tensor(w2, "w2")])
+    with open(path, "wb") as f:
+        f.write(P.encode(P.make_model(graph)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="/tmp/example_mlp.onnx",
+                    help="path to a .onnx file (generated if missing)")
+    args = ap.parse_args()
+
+    import os
+    if not os.path.exists(args.model):
+        build_onnx_file(args.model)
+        print("generated", args.model)
+
+    from analytics_zoo_tpu.pipeline.api.net import Net
+
+    net = Net.load_onnx(args.model)
+    x = np.random.RandomState(1).randn(5, 8).astype(np.float32)
+    preds = net.predict(x)
+    print("predictions:", np.round(preds, 3))
+    print("row sums:", preds.sum(-1))
+
+
+if __name__ == "__main__":
+    main()

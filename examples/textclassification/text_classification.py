@@ -1,0 +1,48 @@
+"""Text classification with the built-in TextClassifier.
+
+Reference analog: pyzoo/zoo/examples/textclassification/ (GloVe embeddings
++ news20; encoders cnn/lstm/gru, TextClassifier.scala:31-60).  Synthetic
+token sequences stand in for news20 here.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--encoder", default="cnn",
+                    choices=["cnn", "lstm", "gru"])
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--sequence-length", type=int, default=50)
+    ap.add_argument("--samples", type=int, default=256)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+
+    n_classes, vocab, token_len = 4, 200, 20
+    rs = np.random.RandomState(0)
+    # class-k documents are biased toward tokens near k * vocab/n_classes
+    y = rs.randint(0, n_classes, size=args.samples).astype(np.int32)
+    tokens = (y[:, None] * (vocab // n_classes)
+              + rs.randint(0, vocab // n_classes,
+                           size=(args.samples, args.sequence_length)))
+    # pre-embed with a fixed random table (the GloVe stand-in; with a real
+    # embedding file pass embedding_file= instead and feed raw token ids)
+    table = rs.randn(vocab, token_len).astype(np.float32)
+    x = table[tokens]
+
+    model = TextClassifier(
+        class_num=n_classes, token_length=token_len,
+        sequence_length=args.sequence_length, encoder=args.encoder,
+        encoder_output_dim=32)
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=32, nb_epoch=args.epochs)
+    print("train metrics:", model.evaluate(x, y, batch_size=32))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Smoke tests: examples must stay runnable (reference keeps its examples
+compiling/running in CI via run-pytests + example scripts)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENV = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+
+
+def run_example(rel, *args, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, rel), *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=os.path.join(REPO, os.path.dirname(rel)))
+    assert proc.returncode == 0, \
+        f"{rel} failed:\n{proc.stdout[-1500:]}\n{proc.stderr[-1500:]}"
+    return proc.stdout
+
+
+class TestExamples:
+    def test_onnx_example(self, tmp_path):
+        out = run_example("examples/onnx/load_onnx_example.py",
+                          "--model", str(tmp_path / "m.onnx"))
+        assert "row sums" in out
+
+    def test_serving_example(self):
+        out = run_example("examples/inference/serving_example.py",
+                          "--quantize")
+        assert "served 8 concurrent requests" in out
+
+    def test_customloss_example(self):
+        out = run_example("examples/autograd/customloss.py",
+                          "--epochs", "2")
+        assert "final train MAE" in out
+
+    def test_lenet_train_then_evaluate(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        run_example("examples/lenet/train_lenet.py", "--epochs", "1",
+                    "--samples", "64", "--batch-size", "32",
+                    "--checkpoint", ckpt)
+        # the async checkpoint must be fully on disk when train exits
+        out = run_example("examples/lenet/evaluate_lenet.py",
+                          "--checkpoint", ckpt, "--samples", "64")
+        assert "evaluation" in out
+
+
+class TestCheckpointRobustness:
+    def test_latest_tag_skips_torn_tmp(self, tmp_path):
+        from analytics_zoo_tpu.train.checkpoint import (
+            latest_tag, restore_checkpoint, save_checkpoint)
+        tree = {"w": np.ones((3,), np.float32)}
+        save_checkpoint(str(tmp_path), "epoch1", tree)
+        # simulate a torn atomic write left by a killed process
+        (tmp_path / "ckpt_epoch2.npz.tmp.npz").write_bytes(b"garbage")
+        assert latest_tag(str(tmp_path)) == "epoch1"
+        restored = restore_checkpoint(str(tmp_path),
+                                      {"w": np.zeros((3,), np.float32)})
+        np.testing.assert_array_equal(restored["w"], tree["w"])
+
+    def test_fit_joins_async_writers(self, tmp_path):
+        from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.layers.core import Dense
+        from analytics_zoo_tpu.train.checkpoint import latest_tag
+
+        model = Sequential()
+        model.add(Dense(2, activation="softmax", input_shape=(4,)))
+        model.compile(optimizer="sgd",
+                      loss="sparse_categorical_crossentropy")
+        model.set_checkpoint(str(tmp_path))
+        rs = np.random.RandomState(0)
+        model.fit(rs.rand(32, 4).astype(np.float32),
+                  rs.randint(0, 2, 32), batch_size=16, nb_epoch=1)
+        # immediately after fit returns the checkpoint is restorable
+        assert latest_tag(str(tmp_path)) == "epoch1"
+        model.load_weights(str(tmp_path))

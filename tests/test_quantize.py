@@ -167,6 +167,20 @@ class TestRegistryAndServing:
         im.reload(path)  # no explicit flag: must stay int8
         assert im._quantize_flag is True
 
+    def test_inference_model_honors_quantize_name(self, tmp_path):
+        # a saved '<arch>-quantize' model must serve int8 without an
+        # explicit flag
+        from analytics_zoo_tpu.models.image.classification import (
+            ImageClassifier)
+        from analytics_zoo_tpu.pipeline.inference.inference_model import (
+            InferenceModel)
+        m = ImageClassifier("squeezenet-quantize",
+                            input_shape=(32, 32, 3), num_classes=3)
+        im = InferenceModel().load_keras_net(m)
+        assert im._quantize_flag is True
+        x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+        assert np.asarray(im.predict(x)).shape == (2, 3)
+
     def test_image_classifier_unknown_name(self):
         from analytics_zoo_tpu.models.image.classification import (
             ImageClassifier)
