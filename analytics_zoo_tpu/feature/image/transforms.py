@@ -48,7 +48,14 @@ def _as_feature(sample) -> ImageFeature:
     if isinstance(sample, ImageFeature):
         return sample
     f = ImageFeature()
-    f["image"] = sample
+    if isinstance(sample, dict):
+        # a plain {'image': pixels, ...} record is a feature, not pixels
+        if "image" not in sample:
+            raise ValueError(
+                "dict sample for an image transform needs an 'image' key")
+        f.update(sample)
+    else:
+        f["image"] = sample
     return f
 
 

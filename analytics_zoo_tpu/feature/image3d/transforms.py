@@ -31,7 +31,14 @@ def _as_feature3d(sample) -> ImageFeature3D:
         f = ImageFeature3D(sample)
         return f
     f = ImageFeature3D()
-    f["image"] = sample
+    if isinstance(sample, dict):
+        # a plain {'image': volume, ...} record is a feature, not pixels
+        if "image" not in sample:
+            raise ValueError(
+                "dict sample for a 3D transform needs an 'image' key")
+        f.update(sample)
+    else:
+        f["image"] = sample
     return f
 
 

@@ -159,6 +159,48 @@ class KerasNet(Layer):
         self.ensure_inference_ready()
         return self.trainer.predict(x, batch_size)
 
+    def transfer_weights_from(self, other: "KerasNet") -> "KerasNet":
+        """Copy weights of layers shared (by name) with ``other`` — the
+        transfer-learning step after graph surgery.  A Model re-rooted
+        with ``new_graph``/new heads shares layer *instances* with its
+        source, but weights live in each model's trainer, so the new
+        model starts from random init until this pulls the trained
+        entries across (the reference gets this implicitly because BigDL
+        weights live inside module objects)."""
+        src = other.ensure_inference_ready().state
+        dst_trainer = self.ensure_inference_ready()
+        dst = dst_trainer.state
+        copied = []
+
+        def merge(mine: dict, theirs: dict) -> dict:
+            out = dict(mine)
+            for k, v in theirs.items():
+                if k not in out:
+                    continue
+                mine_shapes = jax.tree_util.tree_map(np.shape, out[k])
+                their_shapes = jax.tree_util.tree_map(np.shape, v)
+                if mine_shapes != their_shapes:
+                    raise ValueError(
+                        f"transfer_weights_from: layer {k!r} has shapes "
+                        f"{their_shapes} in the source but {mine_shapes} "
+                        "here")
+                out[k] = v
+                copied.append(k)
+            return out
+
+        merged_params = merge(dst.params, src.params)
+        merged_state = merge(dst.model_state, src.model_state)
+        if not copied:
+            raise ValueError(
+                "transfer_weights_from: no layer names in common — the "
+                "models do not share layer instances")
+        # adopt_weights re-places the merged tree under THIS trainer's
+        # shardings (a bare device_put would keep the source placement —
+        # wrong when the destination is mesh-sharded)
+        dst_trainer.adopt_weights(merged_params, merged_state)
+        self._weights_loaded = True
+        return self
+
     def quantize(self) -> "Model":
         """Post-training int8 quantization: returns an inference-only
         functional Model whose Dense/Conv layers run int8 matmuls/convs
@@ -377,7 +419,10 @@ class Model(KerasNet):
         (reference GraphNet.new_graph, NetUtils.scala:216-277)."""
         by_name = {v.name: v for v in self._graph.nodes}
         outs = [by_name[n] for n in outputs]
-        return Model(input=self._graph.input_vars, output=outs,
+        # one name -> single-output model (predict returns the array, not
+        # a one-element list)
+        return Model(input=self._graph.input_vars,
+                     output=outs[0] if len(outs) == 1 else outs,
                      name=f"{self.name}_sub")
 
     def get_config(self):
@@ -397,6 +442,7 @@ class Model(KerasNet):
         return {"name": self.name, "nodes": nodes,
                 "input_ids": input_ids,
                 "output_ids": [v.node_id for v in self._graph.output_vars],
+                "single_output": self._graph.single_output,
                 "compile_args": self._compile_args}
 
     @classmethod
@@ -426,8 +472,10 @@ class Model(KerasNet):
         model = cls(input=[built[i] for i in config["input_ids"]],
                     output=[built[i] for i in config["output_ids"]],
                     name=config.get("name"))
-        if len(config["output_ids"]) == 1:
-            model._graph.single_output = True
+        # restore the saved output arity (older configs lack the key:
+        # fall back to "one output means single")
+        model._graph.single_output = config.get(
+            "single_output", len(config["output_ids"]) == 1)
         model._compile_args = config.get("compile_args")
         return model
 

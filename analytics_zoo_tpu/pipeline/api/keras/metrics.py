@@ -92,8 +92,18 @@ class AUC(Metric):
                 "pos": jnp.zeros(()), "neg": jnp.zeros(())}
 
     def update(self, acc, y_true, y_pred):
-        scores = y_pred.reshape(-1)
-        labels = y_true.reshape(-1) > 0.5
+        scores = y_pred
+        if scores.ndim > 1 and scores.shape[-1] == 2:
+            scores = scores[..., 1]  # binary softmax: P(positive class)
+        scores = scores.reshape(-1)
+        labels = y_true
+        if labels.ndim > 1 and labels.shape[-1] == 2:
+            labels = jnp.argmax(labels, axis=-1)
+        labels = labels.reshape(-1) > 0.5
+        if scores.shape[0] != labels.shape[0]:
+            raise ValueError(
+                f"AUC is a binary metric: y_pred {y_pred.shape} does not "
+                f"reduce to one score per sample of y_true {y_true.shape}")
         thresholds = jnp.linspace(0.0, 1.0, self.threshold_num)
         above = scores[None, :] >= thresholds[:, None]  # (n_thresh, n)
         tp = jnp.sum(above & labels[None, :], axis=1)

@@ -50,6 +50,28 @@ class TestExamples:
         assert "evaluation" in out
 
 
+class TestApps:
+    def test_augmentation_app(self):
+        out = run_example("apps/image-augmentation/augmentation.py")
+        assert "2D pipeline output: (4, 24, 24, 3)" in out
+        assert "3D pipeline output: (16, 16, 16)" in out
+
+    def test_image_similarity_app(self):
+        out = run_example("apps/image-similarity/image_similarity.py")
+        assert "top-5 purity" in out
+
+    def test_transfer_learning_weights_actually_transfer(self):
+        # regression for transfer_weights_from: frozen-backbone task B
+        # must beat chance by a wide margin
+        out = run_example("apps/transfer-learning/transfer_learning.py",
+                          "--epochs", "3")
+        import re
+        m = re.search(r"task B \(frozen backbone\): \{'accuracy': ([0-9.]+)",
+                      out)
+        assert m, out
+        assert float(m.group(1)) > 0.8
+
+
 class TestCheckpointRobustness:
     def test_latest_tag_skips_torn_tmp(self, tmp_path):
         from analytics_zoo_tpu.train.checkpoint import (
