@@ -5,10 +5,13 @@ Top5Accuracy, AUC}.scala.  Accuracy is zero-based-label aware
 (Accuracy.scala:30); AUC uses the reference's threshold-sweep formulation
 (AUC.scala:128, thresholdNum default 200).
 
-Metrics are streaming: ``init() -> acc``, ``update(acc, y_true, y_pred) ->
-acc``, ``result(acc) -> scalar``.  The accumulator is a small pytree of jnp
-scalars, so updates run inside the jitted eval step and only ``result`` pulls
-a host value.
+Metrics are streaming: ``init() -> acc``, ``update(acc, y_true, y_pred,
+mask=None) -> acc``, ``result(acc) -> scalar``.  The accumulator is a small
+pytree of jnp scalars, so updates run inside the jitted eval step and only
+``result`` pulls a host value.  ``mask`` is an optional per-sample 0/1
+weight vector — the trailing partial batch of an evaluation is padded to
+the compiled batch shape and masked out, so metrics cover the exact ``n``
+samples (the reference evaluates the full set, Topology.scala:353).
 """
 
 from __future__ import annotations
@@ -18,13 +21,26 @@ from typing import Any, Dict
 import jax.numpy as jnp
 
 
+def _sample_mask(mask, n):
+    """Resolve mask to a float (n,) weight vector (all-ones when None).
+    When predictions flatten to batch*T elements (sequence outputs) the
+    per-sample mask is repeated so each sample's T elements share its
+    weight."""
+    if mask is None:
+        return jnp.ones((n,), jnp.float32)
+    w = mask.reshape(-1).astype(jnp.float32)
+    if w.shape[0] != n and n % w.shape[0] == 0:
+        w = jnp.repeat(w, n // w.shape[0])
+    return w
+
+
 class Metric:
     name = "metric"
 
     def init(self):
         raise NotImplementedError
 
-    def update(self, acc, y_true, y_pred):
+    def update(self, acc, y_true, y_pred, mask=None):
         raise NotImplementedError
 
     def result(self, acc):
@@ -40,7 +56,7 @@ class Accuracy(Metric):
     def init(self):
         return {"correct": jnp.zeros(()), "total": jnp.zeros(())}
 
-    def update(self, acc, y_true, y_pred):
+    def update(self, acc, y_true, y_pred, mask=None):
         if y_pred.ndim >= 2 and y_pred.shape[-1] > 1:
             pred = jnp.argmax(y_pred, axis=-1)
             if y_true.ndim == y_pred.ndim and y_true.shape[-1] == y_pred.shape[-1]:
@@ -53,9 +69,12 @@ class Accuracy(Metric):
                     y_pred) > 0.5
             true = (jnp.squeeze(y_true, -1) if y_true.ndim > 1 else
                     y_true) > 0.5
-        correct = jnp.sum(pred == true)
+        w = _sample_mask(mask, pred.shape[0] if pred.ndim else 1)
+        w = w.reshape((-1,) + (1,) * (pred.ndim - 1))
+        per_elem = w * jnp.ones(pred.shape, jnp.float32)
+        correct = jnp.sum((pred == true) * per_elem)
         return {"correct": acc["correct"] + correct,
-                "total": acc["total"] + pred.size}
+                "total": acc["total"] + jnp.sum(per_elem)}
 
     def result(self, acc):
         return acc["correct"] / jnp.maximum(acc["total"], 1)
@@ -67,12 +86,13 @@ class Top5Accuracy(Metric):
     def init(self):
         return {"correct": jnp.zeros(()), "total": jnp.zeros(())}
 
-    def update(self, acc, y_true, y_pred):
+    def update(self, acc, y_true, y_pred, mask=None):
         true = jnp.squeeze(y_true).astype(jnp.int32).reshape(-1)
+        w = _sample_mask(mask, true.shape[0])
         top5 = jnp.argsort(y_pred, axis=-1)[..., -5:].reshape(len(true), 5)
-        correct = jnp.sum(jnp.any(top5 == true[:, None], axis=-1))
+        correct = jnp.sum(jnp.any(top5 == true[:, None], axis=-1) * w)
         return {"correct": acc["correct"] + correct,
-                "total": acc["total"] + len(true)}
+                "total": acc["total"] + jnp.sum(w)}
 
     def result(self, acc):
         return acc["correct"] / jnp.maximum(acc["total"], 1)
@@ -91,7 +111,7 @@ class AUC(Metric):
         return {"tp": jnp.zeros((n,)), "fp": jnp.zeros((n,)),
                 "pos": jnp.zeros(()), "neg": jnp.zeros(())}
 
-    def update(self, acc, y_true, y_pred):
+    def update(self, acc, y_true, y_pred, mask=None):
         scores = y_pred
         if scores.ndim > 1 and scores.shape[-1] == 2:
             scores = scores[..., 1]  # binary softmax: P(positive class)
@@ -104,13 +124,16 @@ class AUC(Metric):
             raise ValueError(
                 f"AUC is a binary metric: y_pred {y_pred.shape} does not "
                 f"reduce to one score per sample of y_true {y_true.shape}")
+        w = _sample_mask(mask, scores.shape[0])
         thresholds = jnp.linspace(0.0, 1.0, self.threshold_num)
         above = scores[None, :] >= thresholds[:, None]  # (n_thresh, n)
-        tp = jnp.sum(above & labels[None, :], axis=1)
-        fp = jnp.sum(above & ~labels[None, :], axis=1)
+        pos_w = labels * w
+        neg_w = (~labels) * w
+        tp = jnp.sum(above * pos_w[None, :], axis=1)
+        fp = jnp.sum(above * neg_w[None, :], axis=1)
         return {"tp": acc["tp"] + tp, "fp": acc["fp"] + fp,
-                "pos": acc["pos"] + jnp.sum(labels),
-                "neg": acc["neg"] + jnp.sum(~labels)}
+                "pos": acc["pos"] + jnp.sum(pos_w),
+                "neg": acc["neg"] + jnp.sum(neg_w)}
 
     def result(self, acc):
         tpr = acc["tp"] / jnp.maximum(acc["pos"], 1)
@@ -130,10 +153,11 @@ class Loss(Metric):
     def init(self):
         return {"sum": jnp.zeros(()), "total": jnp.zeros(())}
 
-    def update(self, acc, y_true, y_pred):
+    def update(self, acc, y_true, y_pred, mask=None):
         per_sample = self.loss_fn(y_true, y_pred)
-        return {"sum": acc["sum"] + jnp.sum(per_sample),
-                "total": acc["total"] + per_sample.shape[0]}
+        w = _sample_mask(mask, per_sample.shape[0])
+        return {"sum": acc["sum"] + jnp.sum(per_sample * w),
+                "total": acc["total"] + jnp.sum(w)}
 
     def result(self, acc):
         return acc["sum"] / jnp.maximum(acc["total"], 1)
@@ -145,9 +169,13 @@ class MAE(Metric):
     def init(self):
         return {"sum": jnp.zeros(()), "total": jnp.zeros(())}
 
-    def update(self, acc, y_true, y_pred):
-        return {"sum": acc["sum"] + jnp.sum(jnp.abs(y_true - y_pred)),
-                "total": acc["total"] + y_pred.size}
+    def update(self, acc, y_true, y_pred, mask=None):
+        err = jnp.abs(y_true - y_pred)
+        w = _sample_mask(mask, err.shape[0] if err.ndim else 1)
+        w = w.reshape((-1,) + (1,) * (err.ndim - 1))
+        per_elem = w * jnp.ones(err.shape, jnp.float32)
+        return {"sum": acc["sum"] + jnp.sum(err * per_elem),
+                "total": acc["total"] + jnp.sum(per_elem)}
 
     def result(self, acc):
         return acc["sum"] / jnp.maximum(acc["total"], 1)

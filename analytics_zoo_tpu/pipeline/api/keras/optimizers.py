@@ -9,9 +9,20 @@ the reference bolts clipping onto the Optimizer (Topology.scala:200-230).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, NamedTuple, Optional
 
 import optax
+
+
+class ZooOptimizer(NamedTuple):
+    """An optax GradientTransformation plus the resolved learning-rate
+    schedule, so the Trainer can emit the LearningRate TrainSummary scalar
+    (reference wires Loss/LearningRate/Throughput, Topology.scala:157-175).
+    Duck-types optax: only ``init``/``update`` are consumed downstream."""
+
+    init: Callable
+    update: Callable
+    lr_fn: Optional[Callable] = None
 
 
 def get(optimizer, clip_norm: Optional[float] = None,
@@ -21,8 +32,10 @@ def get(optimizer, clip_norm: Optional[float] = None,
     ``optimizer`` may be a string name, an optax transformation, or a dict
     {"name": ..., "lr"/"learning_rate": ..., extra kwargs}.
     """
-    if isinstance(optimizer, optax.GradientTransformation):
+    lr_fn = None
+    if isinstance(optimizer, (optax.GradientTransformation, ZooOptimizer)):
         opt = optimizer
+        lr_fn = getattr(optimizer, "lr_fn", None)
     else:
         if isinstance(optimizer, str):
             spec = {"name": optimizer}
@@ -33,31 +46,20 @@ def get(optimizer, clip_norm: Optional[float] = None,
         name = spec.pop("name").lower()
         lr = spec.pop("lr", spec.pop("learning_rate", None))
         schedule = _schedule(lr, spec)
+        defaults = {"sgd": 0.01, "adam": 1e-3, "adamax": 2e-3,
+                    "adagrad": 1e-2, "adadelta": 1.0, "rmsprop": 1e-3,
+                    "adamw": 1e-3, "lamb": 1e-3, "lars": 1e-3}
+        if name not in defaults:
+            raise ValueError(f"Unknown optimizer {name!r}")
+        resolved = schedule if schedule is not None else defaults[name]
         if name == "sgd":
             momentum = spec.pop("momentum", 0.0) or None
             nesterov = spec.pop("nesterov", False)
-            opt = optax.sgd(schedule if schedule is not None else 0.01,
-                            momentum=momentum, nesterov=nesterov)
-        elif name == "adam":
-            opt = optax.adam(schedule if schedule is not None else 1e-3,
-                             **spec)
-        elif name == "adamax":
-            opt = optax.adamax(schedule if schedule is not None else 2e-3,
-                               **spec)
-        elif name == "adagrad":
-            opt = optax.adagrad(schedule if schedule is not None else 1e-2,
-                                **spec)
-        elif name == "adadelta":
-            opt = optax.adadelta(schedule if schedule is not None else 1.0,
-                                 **spec)
-        elif name == "rmsprop":
-            opt = optax.rmsprop(schedule if schedule is not None else 1e-3,
-                                **spec)
-        elif name in ("adamw", "lamb", "lars"):
-            opt = getattr(optax, name)(
-                schedule if schedule is not None else 1e-3, **spec)
+            opt = optax.sgd(resolved, momentum=momentum, nesterov=nesterov)
         else:
-            raise ValueError(f"Unknown optimizer {name!r}")
+            opt = getattr(optax, name)(resolved, **spec)
+        lr_fn = (resolved if callable(resolved)
+                 else (lambda step, _lr=resolved: _lr))
 
     chain = []
     if clip_value is not None:
@@ -67,7 +69,8 @@ def get(optimizer, clip_norm: Optional[float] = None,
         # reference setGradientClippingByL2Norm (Topology.scala:219-224)
         chain.append(optax.clip_by_global_norm(clip_norm))
     chain.append(opt)
-    return optax.chain(*chain) if len(chain) > 1 else opt
+    final = optax.chain(*chain) if len(chain) > 1 else opt
+    return ZooOptimizer(final.init, final.update, lr_fn=lr_fn)
 
 
 def _schedule(lr, spec):

@@ -28,13 +28,28 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ..data.dataset import Dataset, check_batch_divisibility, shard_batch
+from ..data.dataset import (Dataset, check_batch_divisibility,
+                            prefetch_iterator, shard_batch)
 from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
 from . import triggers as trigger_lib
 from .checkpoint import async_save
 from .checkpoint import wait_pending as checkpoint_lib_wait_pending
 from .summary import TrainSummary, ValidationSummary
+
+
+def _pad_tail(batch, pad: int):
+    """Zero-pad the leading axis of every array in a batch (array or
+    tuple/list of arrays) by ``pad`` rows, keeping one compiled shape for
+    the trailing partial batch of evaluate/predict."""
+
+    def _pad(a):
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
+
+    if isinstance(batch, (tuple, list)):
+        return tuple(_pad(a) for a in batch)
+    return _pad(batch)
 
 
 class TrainState:
@@ -199,14 +214,16 @@ class Trainer:
         model, metrics = self.model, self.metrics
         loss_fn = self.loss_fn
 
-        def eval_step(params, model_state, accs, loss_acc, x, y):
+        def eval_step(params, model_state, accs, loss_acc, x, y, mask):
             y_pred, _ = model.apply(params, model_state, x, training=False)
-            new_accs = [m.update(a, y, y_pred)
+            new_accs = [m.update(a, y, y_pred, mask)
                         for m, a in zip(metrics, accs)]
             if loss_fn is not None:
                 per_sample = loss_fn(y, y_pred)
-                loss_acc = {"sum": loss_acc["sum"] + jnp.sum(per_sample),
-                            "n": loss_acc["n"] + per_sample.shape[0]}
+                w = mask.reshape(-1).astype(jnp.float32)
+                loss_acc = {"sum": loss_acc["sum"]
+                            + jnp.sum(per_sample * w),
+                            "n": loss_acc["n"] + jnp.sum(w)}
             return new_accs, loss_acc
 
         return jax.jit(eval_step)
@@ -272,36 +289,61 @@ class Trainer:
         history: Dict[str, List] = {"loss": [], "val": []}
         st = self.state
 
+        lr_fn = getattr(self.optimizer, "lr_fn", None)
+        stop = False
+
         while True:
             record = {"epoch": st.epoch, "iteration": st.step}
-            if end_trigger(record):
+            if stop or end_trigger(record):
                 break
             epoch_start, epoch_samples = time.time(), 0
-            for bx, by in dataset.batches(batch_size, shuffle=shuffle,
-                                          seed=self.seed, epoch=st.epoch):
-                bx, by = self._put_batch(bx, by)
+            # per-epoch device-side loss buffer: NO per-step host sync —
+            # losses stay on device and are fetched in one bulk transfer at
+            # the epoch boundary (the round-1 `float(loss)` per step
+            # destroyed async dispatch).  Loss-dependent triggers (MinLoss)
+            # still work: the record carries the device scalar and only
+            # such a trigger pays the sync.
+            epoch_losses = []
+            batch_it = dataset.batches(batch_size, shuffle=shuffle,
+                                       seed=self.seed, epoch=st.epoch)
+            for bx, by in prefetch_iterator(
+                    batch_it, lambda b: self._put_batch(*b)):
                 step_rng = jax.random.fold_in(st.rng, st.step)
                 st.params, st.model_state, st.opt_state, loss = \
                     self._train_step(st.params, st.model_state,
                                      st.opt_state, step_rng, bx, by)
                 st.step += 1
                 epoch_samples += batch_size
-                lossf = float(loss)
-                history["loss"].append(lossf)
-                if self.train_summary is not None:
-                    self.train_summary.add_scalar("Loss", lossf, st.step)
+                epoch_losses.append(loss)
                 it_record = {"epoch": st.epoch, "iteration": st.step,
-                             "loss": lossf}
+                             "loss": loss}
                 if self._ckpt_path and not isinstance(
                         self._ckpt_trigger, trigger_lib.EveryEpoch) \
                         and self._ckpt_trigger(it_record):
                     async_save(self._ckpt_path, st.step, st.as_tree(),
                                meta={"step": st.step, "epoch": st.epoch})
                 if end_trigger(it_record):
+                    # remember the firing so the outer loop terminates even
+                    # for triggers the outer record can't re-evaluate
+                    # (e.g. MinLoss — the per-epoch record carries no loss)
+                    stop = True
                     break
             st.epoch += 1
+            # one bulk host transfer for the whole epoch's scalars
+            losses_host = ([float(v) for v in
+                            np.asarray(jax.device_get(epoch_losses))]
+                           if epoch_losses else [])
+            base_step = st.step - len(losses_host)
+            history["loss"].extend(losses_host)
             elapsed = max(time.time() - epoch_start, 1e-9)
             if self.train_summary is not None:
+                for i, lossf in enumerate(losses_host):
+                    step_i = base_step + i + 1
+                    self.train_summary.add_scalar("Loss", lossf, step_i)
+                    if lr_fn is not None:
+                        self.train_summary.add_scalar(
+                            "LearningRate", float(lr_fn(step_i - 1)),
+                            step_i)
                 self.train_summary.add_scalar(
                     "Throughput", epoch_samples / elapsed, st.step)
                 self.train_summary.flush()
@@ -337,16 +379,39 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def evaluate(self, dataset: Dataset, batch_size: int) -> Dict[str, float]:
+        """Evaluate over the FULL dataset — the trailing partial batch is
+        padded to the compiled batch shape and masked out of every metric,
+        so n % batch_size != 0 loses no samples (reference evaluates the
+        whole set, Topology.scala:353)."""
         self.ensure_initialized()
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         accs = [m.init() for m in self.metrics]
         loss_acc = {"sum": jnp.zeros(()), "n": jnp.zeros(())}
-        for bx, by in dataset.batches(batch_size, shuffle=False):
+        dp = mesh_lib.dp_size(self.mesh)
+        mask_sharding = (self._batch_sharding
+                         if batch_size % max(dp, 1) == 0
+                         else self._repl_sharding)
+        full_mask = jax.device_put(np.ones((batch_size,), np.float32),
+                                   mask_sharding)
+        for bx, by in dataset.batches(batch_size, shuffle=False,
+                                      drop_remainder=False):
+            first = bx[0] if isinstance(bx, (tuple, list)) else bx
+            n_real = len(first)
+            if n_real < batch_size:
+                pad = batch_size - n_real
+                bx = _pad_tail(bx, pad)
+                if by is not None:
+                    by = _pad_tail(by, pad)
+                mask = np.zeros((batch_size,), np.float32)
+                mask[:n_real] = 1.0
+                mask_dev = jax.device_put(mask, mask_sharding)
+            else:
+                mask_dev = full_mask
             bx, by = self._put_batch(bx, by)
             accs, loss_acc = self._eval_step(
                 self.state.params, self.state.model_state, accs, loss_acc,
-                bx, by)
+                bx, by, mask_dev)
         results = {m.name: float(m.result(a))
                    for m, a in zip(self.metrics, accs)}
         if self.loss_fn is not None and float(loss_acc["n"]) > 0:
@@ -371,13 +436,7 @@ class Trainer:
             if len(first) < batch_size:
                 # pad the trailing batch to keep one compiled shape
                 pad = batch_size - len(first)
-
-                def _pad(a):
-                    widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-                    return np.pad(a, widths)
-
-                bx = (tuple(_pad(a) for a in bx)
-                      if isinstance(bx, (tuple, list)) else _pad(bx))
+                bx = _pad_tail(bx, pad)
             bx, _ = self._put_batch(bx, None)
             y = self._predict_step(self.state.params, self.state.model_state,
                                    bx)
