@@ -76,15 +76,67 @@ def tensor_parallel_tree(params, mesh: Mesh, rules: Dict[str, Any],
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def combine_spec_trees(base, overlay):
+    """Per-dimension merge of two NamedSharding trees.
+
+    For each param, the overlay's axis assignments win on the dims they
+    name; the base fills the remaining dims — UNLESS the base would reuse
+    a mesh axis the overlay already consumed (a PartitionSpec may not
+    mention one axis twice).  This keeps fsdp and tensor sharding on the
+    *same* param consistent (e.g. a Dense kernel becomes
+    P('fsdp', 'tensor')) instead of either/or — the either/or merge made
+    GSPMD fully rematerialize activation gradients ("[SPMD] Involuntary
+    full rematerialization") because the partitioner had to hop between
+    disjoint shardings mid-backprop."""
+
+    def _axes_of(spec):
+        out = set()
+        for entry in spec:
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                out.update(entry)
+            else:
+                out.add(entry)
+        return out
+
+    def combine(b, o):
+        if o.spec == P():
+            return b
+        if b.spec == P():
+            return o
+        bspec, ospec = list(b.spec), list(o.spec)
+        rank = max(len(bspec), len(ospec))
+        bspec += [None] * (rank - len(bspec))
+        ospec += [None] * (rank - len(ospec))
+        taken = _axes_of(ospec)
+        out = []
+        for bb, oo in zip(bspec, ospec):
+            if oo is not None:
+                out.append(oo)
+            elif bb is not None and not (_axes_of([bb]) & taken):
+                out.append(bb)
+            else:
+                out.append(None)
+        return NamedSharding(b.mesh, P(*out))
+
+    return jax.tree_util.tree_map(combine, base, overlay)
+
+
 def shard_params(params, mesh: Mesh, strategy: str = "replicate",
-                 tp_rules: Optional[Dict[str, int]] = None):
+                 tp_rules: Optional[Dict[str, int]] = None,
+                 fsdp_min_size: int = 2 ** 14):
     """Resolve a named strategy into a sharding pytree."""
     if strategy in ("replicate", "dp"):
         tree = replicated_tree(params, mesh)
     elif strategy == "fsdp":
-        tree = fsdp_tree(params, mesh)
+        tree = fsdp_tree(params, mesh, min_size=fsdp_min_size)
     elif strategy in ("tp", "tensor"):
         tree = tensor_parallel_tree(params, mesh, tp_rules or {})
+    elif strategy in ("fsdp_tp", "fsdp+tp"):
+        tree = combine_spec_trees(
+            fsdp_tree(params, mesh, min_size=fsdp_min_size),
+            tensor_parallel_tree(params, mesh, tp_rules or {}))
     else:
         raise ValueError(f"Unknown sharding strategy {strategy!r}")
     return tree

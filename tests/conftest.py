@@ -22,6 +22,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute integration tests (deselect with -m 'not slow')")
+
+
 @pytest.fixture
 def rng():
     import jax

@@ -1,0 +1,75 @@
+"""Sharding-rule consistency: the multichip path must not force GSPMD
+into "[SPMD] Involuntary full rematerialization" (the round-1 dryrun
+logged these — correct but ICI-wasteful reshardings)."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+from analytics_zoo_tpu.parallel import sharding as sharding_lib
+
+
+@pytest.fixture
+def mesh():
+    return mesh_lib.create_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+
+
+def test_combine_spec_trees_merges_per_dim(mesh):
+    import numpy as np
+    params = {"w": np.zeros((2048, 16), np.float32)}
+    f = sharding_lib.fsdp_tree(params, mesh, min_size=2 ** 10)
+    t = sharding_lib.tensor_parallel_tree(params, mesh, {r"w": 1})
+    assert f["w"].spec == P("fsdp", None)
+    assert t["w"].spec == P(None, "tensor")
+    merged = sharding_lib.combine_spec_trees(f, t)
+    assert merged["w"].spec == P("fsdp", "tensor")
+
+
+def test_combine_spec_trees_drops_conflicting_axis(mesh):
+    """base uses an axis the overlay already consumed on another dim —
+    the base assignment must be dropped (a spec can't repeat an axis)."""
+    base = {"w": NamedSharding(mesh, P("tensor", None))}
+    over = {"w": NamedSharding(mesh, P(None, "tensor"))}
+    merged = sharding_lib.combine_spec_trees(base, over)
+    assert merged["w"].spec == P(None, "tensor")
+
+
+def test_combine_spec_trees_identity_cases(mesh):
+    base = {"w": NamedSharding(mesh, P("fsdp"))}
+    repl = {"w": NamedSharding(mesh, P())}
+    assert sharding_lib.combine_spec_trees(base, repl)["w"].spec == P("fsdp")
+    assert sharding_lib.combine_spec_trees(repl, base)["w"].spec == P("fsdp")
+
+
+def test_shard_params_fsdp_tp_strategy(mesh):
+    import numpy as np
+    params = {"k": np.zeros((1024, 64), np.float32),
+              "b": np.zeros((64,), np.float32)}
+    tree = sharding_lib.shard_params(params, mesh, "fsdp_tp",
+                                     tp_rules={r"k": 1})
+    assert tree["k"].spec == P("fsdp", "tensor")
+    assert tree["b"].spec == P()
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_log_is_clean():
+    """Run the driver's dryrun in a subprocess and assert zero
+    spmd_partitioner warnings (VERDICT r1: MULTICHIP tail must be clean)."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        capture_output=True, text=True, timeout=600,
+        cwd=REPO_ROOT)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-2000:]
+    assert "OK" in out
+    assert "Involuntary full rematerialization" not in out, (
+        "GSPMD remat warnings are back:\n"
+        + "\n".join(l for l in out.splitlines() if "SPMD" in l)[:2000])
