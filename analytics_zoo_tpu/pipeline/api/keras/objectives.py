@@ -83,7 +83,9 @@ def poisson(y_true, y_pred):
 def kullback_leibler_divergence(y_true, y_pred):
     p = jnp.clip(y_true, EPS, 1.0)
     q = jnp.clip(y_pred, EPS, 1.0)
-    return _batch_mean(p * jnp.log(p / q))
+    # Keras-1 semantics: SUM over the distribution axis (objectives.py
+    # kullback_leibler_divergence), not a mean
+    return jnp.sum(p * jnp.log(p / q), axis=-1)
 
 
 def cosine_proximity(y_true, y_pred):

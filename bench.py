@@ -1,29 +1,76 @@
-"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+"""Benchmark: ResNet-50 training throughput, images/sec/chip (+ MFU).
 
 The north-star metric (BASELINE.md): images/sec/chip for ResNet-50 ImageNet
 through the framework's training path.  The reference publishes no absolute
 numbers (BASELINE.json "published": {}), so vs_baseline is reported against
 a fixed nominal target of 100 img/s/chip to give the driver a stable ratio.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout:
+  {"metric", "value", "unit", "vs_baseline", ...extras}
+All progress goes to stderr.
+
+Resilience (the round-1 run produced rc=1 with no parsed number because the
+TPU backend was UNAVAILABLE at capture time): the parent process never
+imports jax; it launches the real benchmark as a time-bounded child, retries
+with back-off when the child hangs or crashes on backend init, and falls
+back to a CPU measurement as a last resort so a parsed value always exists.
+An XLA compilation cache under .jax_cache makes retries cheap.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+# peak dense bf16 FLOP/s per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v5": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
 
 
-def main():
+def _log(msg: str):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------- child ----
+
+def child(platform: str):
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    cache_dir = os.path.join(REPO, ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # cache is an optimization, never a failure
+        _log(f"compilation cache unavailable: {e}")
+
     import jax.numpy as jnp
+    import numpy as np
     import optax
+
+    t0 = time.time()
+    dev = jax.devices()[0]
+    _log(f"backend up in {time.time() - t0:.1f}s: platform={dev.platform} "
+         f"kind={getattr(dev, 'device_kind', '?')}")
+    on_tpu = dev.platform != "cpu"
+    if platform == "tpu" and not on_tpu:
+        # the accelerator quietly fell back to CPU (round-1 failure mode);
+        # fail fast so the parent retries instead of accepting a CPU number
+        _log("requested TPU but backend initialized CPU — aborting attempt")
+        sys.exit(3)
 
     from analytics_zoo_tpu.models.image.classification import resnet50
     from analytics_zoo_tpu.pipeline.api.keras import objectives
     from analytics_zoo_tpu.train.trainer import build_train_step
 
-    on_tpu = jax.devices()[0].platform != "cpu"
     batch = 64 if on_tpu else 8
     size = 224 if on_tpu else 64
     steps = 20 if on_tpu else 3
@@ -45,10 +92,25 @@ def main():
     y = jnp.asarray(rng.integers(0, 1000, batch), dtype=jnp.int32)
     key = jax.random.PRNGKey(0)
 
-    # warmup / compile
+    # step flops from XLA's own cost model (for MFU); may be unavailable
+    step_flops = None
+    try:
+        cost = jitted.lower(
+            params, state, opt_state, key, x, y).compile().cost_analysis()
+        if cost:
+            f = (cost[0] if isinstance(cost, (list, tuple)) else
+                 cost).get("flops", 0)
+            if f and f > 0:
+                step_flops = float(f)
+    except Exception as e:
+        _log(f"cost_analysis unavailable: {e}")
+
+    _log("compiling train step...")
+    t0 = time.time()
     params, state, opt_state, loss = jitted(params, state, opt_state, key,
                                             x, y)
     jax.block_until_ready(loss)
+    _log(f"compiled + first step in {time.time() - t0:.1f}s")
 
     t0 = time.time()
     for _ in range(steps):
@@ -56,18 +118,131 @@ def main():
                                                 key, x, y)
     jax.block_until_ready(loss)
     elapsed = time.time() - t0
-
-    # build_train_step is a single-device jit here; exactly one chip
-    # participates regardless of how many are visible
     images_per_sec = batch * steps / elapsed
+    _log(f"{steps} steps in {elapsed:.2f}s -> {images_per_sec:.1f} img/s")
+
+    extras = {"platform": dev.platform,
+              "device_kind": getattr(dev, "device_kind", "unknown"),
+              "batch": batch, "image_size": size}
+
+    # ---- MFU: achieved flops / peak flops for this chip ----
+    if step_flops is None:
+        # analytic fallback: ResNet-50 fwd ~= 4.09 GFLOP/img at 224px,
+        # train step ~= 3x fwd; scale quadratically for other sizes
+        step_flops = 3 * 4.09e9 * (size / 224.0) ** 2 * batch
+        extras["flops_source"] = "analytic"
+    else:
+        extras["flops_source"] = "xla_cost_analysis"
+    kind = str(extras["device_kind"]).lower()
+    peak = next((v for k, v in PEAK_FLOPS.items() if k in kind), None)
+    if on_tpu and peak:
+        extras["mfu"] = round(step_flops * steps / elapsed / peak, 4)
+        extras["peak_flops"] = peak
+    extras["step_tflops"] = round(step_flops / 1e12, 3)
+
+    # ---- pallas flash-attention on-chip microbench (VERDICT r1 #8) ----
+    try:
+        extras["flash_attention"] = _bench_attention(jax, jnp, on_tpu)
+    except Exception as e:
+        extras["flash_attention"] = {"error": f"{type(e).__name__}: {e}"}
+        _log(f"flash attention bench failed: {e}")
+
     baseline = 100.0  # nominal target (no published reference number)
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(images_per_sec, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(images_per_sec / baseline, 3),
-    }))
+        **extras,
+    }), flush=True)
+
+
+def _bench_attention(jax, jnp, on_tpu: bool):
+    """Compile + time the pallas flash-attention kernel on the real chip
+    against the XLA blockwise formulation; returns a dict of TFLOP/s."""
+    import numpy as np
+    from analytics_zoo_tpu.ops.attention import (blockwise_attention,
+                                                 flash_attention)
+
+    b, s, h, d = (4, 2048, 8, 128) if on_tpu else (1, 256, 2, 64)
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, s, h, d)),
+                             dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    # attention flops: 2 matmuls of (s x d) @ (d x s) per head -> 4*b*h*s^2*d;
+    # both kernels run causal, which does ~half the s^2 work
+    flops = 4.0 * b * h * s * s * d / 2.0
+    out = {"shape": [b, s, h, d]}
+
+    def timed(fn, name):
+        t0 = time.time()
+        r = fn(q, k, v)
+        jax.block_until_ready(r)
+        compile_s = time.time() - t0
+        n = 10 if on_tpu else 2
+        t0 = time.time()
+        for _ in range(n):
+            r = fn(q, k, v)
+        jax.block_until_ready(r)
+        dt = (time.time() - t0) / n
+        _log(f"attention/{name}: compile {compile_s:.1f}s, "
+             f"{flops / dt / 1e12:.2f} TFLOP/s")
+        return {"tflops": round(flops / dt / 1e12, 2),
+                "ms": round(dt * 1e3, 2)}
+
+    impl = "pallas" if on_tpu else "pallas_interpret"
+    flash = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=not on_tpu))
+    block = jax.jit(lambda q, k, v: blockwise_attention(q, k, v,
+                                                        causal=True))
+    out[impl] = timed(flash, impl)
+    out["blockwise_xla"] = timed(block, "blockwise_xla")
+    # numerics cross-check on the chip (bf16 tolerance)
+    ref = block(q, k, v)
+    got = flash(q, k, v)
+    err = float(jnp.max(jnp.abs(ref.astype(jnp.float32)
+                                - got.astype(jnp.float32))))
+    out["max_abs_diff_vs_blockwise"] = round(err, 4)
+    return out
+
+
+# --------------------------------------------------------------- parent ----
+
+def main():
+    # attempts: (platform, timeout_s, backoff_after_s).  TPU init through
+    # the tunnel can hang outright, so attempts are time-boxed and the
+    # last resort is a CPU measurement — a parsed value must always exist.
+    plan = [("tpu", 1200, 20), ("tpu", 900, 0), ("cpu", 900, 0)]
+    last_fail = None
+    for i, (platform, timeout, backoff) in enumerate(plan):
+        _log(f"attempt {i + 1}/{len(plan)}: platform={platform} "
+             f"timeout={timeout}s")
+        env = dict(os.environ)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child",
+                 platform],
+                cwd=REPO, env=env, timeout=timeout,
+                stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
+            lines = [l for l in proc.stdout.splitlines()
+                     if l.startswith("{")]
+            if proc.returncode == 0 and lines:
+                print(lines[-1], flush=True)
+                return 0
+            last_fail = f"rc={proc.returncode}"
+            _log(f"attempt failed: {last_fail}")
+        except subprocess.TimeoutExpired:
+            last_fail = f"timeout after {timeout}s"
+            _log(f"attempt timed out ({timeout}s) — backend likely hung")
+        if backoff:
+            _log(f"backing off {backoff}s")
+            time.sleep(backoff)
+    _log(f"all attempts failed ({last_fail})")
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
+    else:
+        sys.exit(main())
