@@ -21,6 +21,7 @@ program has static shapes — the precondition for MXU-friendly XLA tiling.
 from __future__ import annotations
 
 import collections
+import contextlib
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -64,9 +65,32 @@ def get_layer_class(name: str) -> type:
     return _LAYER_REGISTRY[name]
 
 
+_SCOPE_STACK: list = []
+
+
 def fresh_name(prefix: str) -> str:
+    if _SCOPE_STACK:
+        scope, counter = _SCOPE_STACK[-1]
+        counter[prefix] += 1
+        return f"{scope}/{prefix}_{counter[prefix]}"
     _NAME_COUNTERS[prefix] += 1
     return f"{prefix}_{_NAME_COUNTERS[prefix]}"
+
+
+@contextlib.contextmanager
+def name_scope(scope: str):
+    """Deterministic layer naming: inside the scope, auto-names restart
+    from a scope-local counter (``<scope>/<type>_<k>``), so rebuilding the
+    same architecture yields identical parameter keys in ANY process.
+    Without this, checkpoint keys depend on how many layers the saving
+    process happened to create earlier — weights saved from a ZooModel
+    could not be restored into a freshly built copy (the lexicographic
+    order of ``conv_9`` vs ``conv_10`` flips the flattened leaf order)."""
+    _SCOPE_STACK.append((scope, collections.Counter()))
+    try:
+        yield
+    finally:
+        _SCOPE_STACK.pop()
 
 
 class Layer:

@@ -11,6 +11,7 @@ import json
 import os
 from typing import Optional
 
+from ..core.module import name_scope
 from ..pipeline.api.keras.engine import KerasNet, _MODEL_CLASSES
 
 
@@ -20,7 +21,10 @@ class ZooModel(KerasNet):
     def __init__(self, name=None, **hyper):
         super().__init__(name=name)
         self.hyper = hyper
-        self.model = self.build_model()
+        # deterministic inner-layer names: weights saved from this model
+        # restore into a rebuild in any process (see name_scope docstring)
+        with name_scope(type(self).__name__.lower()):
+            self.model = self.build_model()
 
     def build_model(self) -> KerasNet:
         raise NotImplementedError

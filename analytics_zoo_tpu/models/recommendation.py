@@ -120,7 +120,7 @@ class NeuralCF(Recommender):
 
     def build_model(self) -> Model:
         h = self.hyper
-        pair = Input((2,), name=f"{self.name}_pair")
+        pair = Input((2,), name="pair_input")
         user = pair.index_select(1, 0)  # (batch,)
         item = pair.index_select(1, 1)
         # +1: ids are 1-based (reference LookupTable semantics)
@@ -145,7 +145,7 @@ class NeuralCF(Recommender):
         from ..pipeline.api.keras.layers import Activation
         log_probs = Activation("log_softmax")(logits)
         return Model(input=pair, output=log_probs,
-                     name=f"{self.name}_net")
+                     name="net")
 
 
 @register_zoo_model
@@ -202,7 +202,7 @@ class WideAndDeep(Recommender):
         inputs, wide_out, deep_out = [], None, None
 
         if model_type in ("wide", "wide_n_deep"):
-            wide_in = Input((n_wide_cols,), name=f"{self.name}_wide")
+            wide_in = Input((n_wide_cols,), name="wide_input")
             inputs.append(wide_in)
             # sparse linear: sum one-hot(id) @ W == sum of embedding rows
             # (reference LookupTableSparse init Zeros + CAdd bias)
@@ -210,12 +210,12 @@ class WideAndDeep(Recommender):
                                    init="zero")(wide_in)
             wide_sum = A.sum(wide_embed, axis=1)  # (batch, num_classes)
             bias = A.Parameter((num_classes,), init_method="zero",
-                               name=f"{self.name}_wide_bias")
+                               name="wide_bias")
             wide_out = wide_sum + bias
 
         if model_type in ("deep", "wide_n_deep"):
             deep_width = indicator_width + n_embed + n_cont
-            deep_in = Input((deep_width,), name=f"{self.name}_deep")
+            deep_in = Input((deep_width,), name="deep_input")
             inputs.append(deep_in)
             parts = []
             if indicator_width:
@@ -244,4 +244,4 @@ class WideAndDeep(Recommender):
         from ..pipeline.api.keras.layers import Activation
         out = Activation("log_softmax")(logits)
         return Model(input=inputs if len(inputs) > 1 else inputs[0],
-                     output=out, name=f"{self.name}_net")
+                     output=out, name="net")

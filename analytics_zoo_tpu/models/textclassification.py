@@ -32,7 +32,7 @@ class TextClassifier(ZooModel):
 
     def build_model(self) -> Sequential:
         h = self.hyper
-        model = Sequential(name=f"{self.name}_net")
+        model = Sequential(name="net")
         if h.get("embedding_file"):
             model.add(WordEmbedding(
                 h["embedding_file"], word_index=h.get("word_index"),

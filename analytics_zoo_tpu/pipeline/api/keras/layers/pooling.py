@@ -91,6 +91,14 @@ class MaxPooling1D(_PoolND):
                          border_mode=border_mode, input_shape=input_shape,
                          name=name)
 
+    def get_config(self):
+        # 1D ctors speak Keras-1 arg names (pool_length/stride), not the
+        # shared _PoolND names — emit what from_config can consume
+        cfg = Layer.get_config(self)
+        cfg.update(pool_length=self.pool_size[0], stride=self.strides[0],
+                   border_mode=self.border_mode)
+        return cfg
+
 
 @register_layer
 class AveragePooling1D(_PoolND):
@@ -101,6 +109,12 @@ class AveragePooling1D(_PoolND):
         super().__init__(pool_size=pool_length, strides=stride,
                          border_mode=border_mode, input_shape=input_shape,
                          name=name)
+
+    def get_config(self):
+        cfg = Layer.get_config(self)
+        cfg.update(pool_length=self.pool_size[0], stride=self.strides[0],
+                   border_mode=self.border_mode)
+        return cfg
 
 
 @register_layer
