@@ -18,6 +18,7 @@ from typing import Dict, Optional
 
 import jax
 
+from ..parallel import distributed as dist_lib
 from ..parallel import mesh as mesh_lib
 
 log = logging.getLogger("analytics_zoo_tpu")
@@ -78,9 +79,16 @@ def init_nncontext(conf: Optional[ZooTpuConfig] = None,
     logging.basicConfig(level=getattr(logging, conf.log_level, logging.INFO))
     if conf.version_check:
         check_version()
+    # join the pod-wide cluster BEFORE the first backend-initializing jax
+    # call, when launcher/cloud env vars are present (the reference's
+    # Engine.init-before-use ordering, NNContext.scala:132-146) — after
+    # this, jax.devices() below is the GLOBAL device list and the mesh
+    # spans every host in the pod
+    dist_lib.maybe_initialize_distributed()
     mesh = mesh_lib.create_mesh(conf.mesh_axes)
     mesh_lib.set_default_mesh(mesh)
-    log.info("initNNContext: %d %s device(s), mesh %s",
+    log.info("initNNContext: process %d/%d, %d %s device(s), mesh %s",
+             jax.process_index(), jax.process_count(),
              len(jax.devices()), jax.devices()[0].platform,
              dict(mesh.shape))
     _CONTEXT = NNContext(conf, mesh)

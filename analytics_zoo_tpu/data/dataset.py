@@ -33,10 +33,13 @@ def _stack_tree(samples: List[Any]):
 class Dataset:
     """A finite, re-iterable dataset of (x, y) pairs (y may be None)."""
 
-    def __init__(self, x, y=None, size: Optional[int] = None):
+    def __init__(self, x, y=None, size: Optional[int] = None, valid=None):
         self.x = x
         self.y = y
         self._size = size
+        # optional per-row validity (False rows are wrap-around fillers
+        # from shard_by_process) — evaluate() masks them out of metrics
+        self.valid = valid
 
     # ---- constructors (parity with TFDataset.from_* family) ----
     @classmethod
@@ -111,6 +114,28 @@ class Dataset:
             return self.size // batch_size
         return math.ceil(self.size / batch_size)
 
+    def shard_by_process(self, process_index: Optional[int] = None,
+                         process_count: Optional[int] = None) -> "Dataset":
+        """This host's shard for multi-host training — the TPU-native
+        analog of the reference's RDD-partition→executor assignment
+        (net.py:458-468).  Rows are taken strided (``x[pid::nproc]``) and
+        the trailing ragged edge is wrapped around so every process holds
+        exactly ``ceil(n / nproc)`` rows — equal per-host step counts keep
+        the pod-wide SPMD program in lockstep (at most ``nproc - 1``
+        duplicated samples per epoch).  Wrapped filler rows are flagged in
+        ``.valid`` so ``evaluate`` excludes them from metrics."""
+        pid = (process_index if process_index is not None
+               else jax.process_index())
+        pc = (process_count if process_count is not None
+              else jax.process_count())
+        n = self.size
+        per = math.ceil(n / pc)
+        raw = np.arange(pid, pid + per * pc, pc)
+        idx = raw % n
+        valid = raw < n
+        return Dataset(self._index(self.x, idx), self._index(self.y, idx),
+                       size=per, valid=None if valid.all() else valid)
+
     def map(self, fn: Callable) -> "Dataset":
         """Apply fn to every (x, y) pair eagerly (Preprocessing chains from
         feature/common.py slot in here)."""
@@ -124,16 +149,23 @@ class Dataset:
             ys.append(out[1])
         x = _stack_tree(xs)
         y = _stack_tree(ys) if ys[0] is not None else None
-        return Dataset(x, y, size=n)
+        return Dataset(x, y, size=n, valid=self.valid)
 
 
-def check_batch_divisibility(batch_size: int, dp: int):
-    """The reference's hard contract (net.py:461-465), lifted to the mesh."""
+def check_batch_divisibility(batch_size: int, dp: int, n_processes: int = 1):
+    """The reference's hard contract (net.py:461-465), lifted to the mesh:
+    the global batch must divide the data-parallel degree and (multi-host)
+    the process count, so every host feeds an equal per-host shard."""
     if batch_size % max(dp, 1) != 0:
         raise ValueError(
             f"batch_size ({batch_size}) must be divisible by the data-"
             f"parallel degree ({dp}) — same invariant as the reference's "
             "batch_size % total_core_num == 0")
+    if batch_size % max(n_processes, 1) != 0:
+        raise ValueError(
+            f"global batch_size ({batch_size}) must be divisible by the "
+            f"number of host processes ({n_processes}) for per-host "
+            "feeding")
 
 
 def prefetch_iterator(iterator: Iterator, put_fn: Callable, depth: int = 2):

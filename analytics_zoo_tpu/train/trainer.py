@@ -30,6 +30,7 @@ import optax
 
 from ..data.dataset import (Dataset, check_batch_divisibility,
                             prefetch_iterator, shard_batch)
+from ..parallel import distributed as dist_lib
 from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
 from . import triggers as trigger_lib
@@ -238,14 +239,34 @@ class Trainer:
         return jax.jit(predict_step)
 
     # ------------------------------------------------------------------
+    _warned_replicated = False
+
     def _put_batch(self, x, y):
+        """Place a host-local batch onto the mesh.  Multi-host: ``x``/``y``
+        are this host's shard of the global batch and every process's
+        shards are assembled into one global array (per-host feeding,
+        reference net.py:458-468)."""
         first = x[0] if isinstance(x, (tuple, list)) else x
         dp = mesh_lib.dp_size(self.mesh)
-        # batches that don't divide the data axis (small predict calls)
-        # fall back to replicated placement instead of failing
-        sharding = (self._batch_sharding if len(first) % max(dp, 1) == 0
-                    else self._repl_sharding)
-        put = lambda a: jax.device_put(a, sharding)
+        nproc = dist_lib.process_count()
+        global_rows = len(first) * nproc
+        divisible = global_rows % max(dp, 1) == 0
+        if not divisible and nproc > 1:
+            raise ValueError(
+                f"global batch ({global_rows}) must divide the data-"
+                f"parallel degree ({dp}) in multi-host execution")
+        if not divisible and not Trainer._warned_replicated:
+            # correct but every device redundantly computes the full batch
+            Trainer._warned_replicated = True
+            import logging
+            logging.getLogger("analytics_zoo_tpu").warning(
+                "batch of %d does not divide the data-parallel degree %d "
+                "— falling back to replicated compute (every device runs "
+                "the full batch). Pad the batch for full speed.",
+                len(first), dp)
+        sharding = self._batch_sharding if divisible else self._repl_sharding
+        put = lambda a: dist_lib.put_global(a, sharding,
+                                            batch_sharded=divisible)
         xs = (tuple(put(a) for a in x) if isinstance(x, (tuple, list))
               else put(x))
         if y is None:
@@ -278,11 +299,18 @@ class Trainer:
 
         Returns a history dict of per-iteration losses and validation
         results.  Successive calls continue from the current epoch
-        (incremental-fit parity)."""
+        (incremental-fit parity).
+
+        ``batch_size`` is the GLOBAL batch.  In multi-host execution each
+        process feeds ``batch_size // process_count`` rows of its local
+        dataset shard per step (per-host feeding, reference
+        net.py:458-468); single-process it is the whole batch."""
         self.ensure_initialized()
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        check_batch_divisibility(batch_size, mesh_lib.dp_size(self.mesh))
+        check_batch_divisibility(batch_size, mesh_lib.dp_size(self.mesh),
+                                 dist_lib.process_count())
+        per_host_bs = batch_size // dist_lib.process_count()
         end_trigger = end_trigger or trigger_lib.MaxEpoch(
             self.state.epoch + 1)
         validation_trigger = validation_trigger or trigger_lib.EveryEpoch()
@@ -304,7 +332,7 @@ class Trainer:
             # still work: the record carries the device scalar and only
             # such a trigger pays the sync.
             epoch_losses = []
-            batch_it = dataset.batches(batch_size, shuffle=shuffle,
+            batch_it = dataset.batches(per_host_bs, shuffle=shuffle,
                                        seed=self.seed, epoch=st.epoch)
             for bx, by in prefetch_iterator(
                     batch_it, lambda b: self._put_batch(*b)):
@@ -389,23 +417,50 @@ class Trainer:
         accs = [m.init() for m in self.metrics]
         loss_acc = {"sum": jnp.zeros(()), "n": jnp.zeros(())}
         dp = mesh_lib.dp_size(self.mesh)
-        mask_sharding = (self._batch_sharding
-                         if batch_size % max(dp, 1) == 0
+        nproc = dist_lib.process_count()
+        per_host_bs = max(batch_size // nproc, 1)
+        if nproc > 1:
+            # the pod must run sharded — round the per-host batch up so
+            # the global batch divides dp (padding is masked out anyway)
+            if dp % nproc != 0:
+                raise ValueError(
+                    f"data-parallel degree ({dp}) must be a multiple of "
+                    f"the process count ({nproc}) for multi-host evaluate")
+            local_dp = dp // nproc
+            per_host_bs = -(-per_host_bs // local_dp) * local_dp
+        batch_size = per_host_bs * nproc
+        sharded = batch_size % max(dp, 1) == 0
+        mask_sharding = (self._batch_sharding if sharded
                          else self._repl_sharding)
-        full_mask = jax.device_put(np.ones((batch_size,), np.float32),
-                                   mask_sharding)
-        for bx, by in dataset.batches(batch_size, shuffle=False,
+        full_mask = dist_lib.put_global(
+            np.ones((per_host_bs if sharded else batch_size,), np.float32),
+            mask_sharding, batch_sharded=sharded)
+        # per-row validity from shard_by_process wrap-around fillers:
+        # they keep the pod in lockstep but must not count in metrics
+        valid = getattr(dataset, "valid", None)
+        offset = 0
+        for bx, by in dataset.batches(per_host_bs, shuffle=False,
                                       drop_remainder=False):
             first = bx[0] if isinstance(bx, (tuple, list)) else bx
             n_real = len(first)
-            if n_real < batch_size:
-                pad = batch_size - n_real
-                bx = _pad_tail(bx, pad)
-                if by is not None:
-                    by = _pad_tail(by, pad)
-                mask = np.zeros((batch_size,), np.float32)
-                mask[:n_real] = 1.0
-                mask_dev = jax.device_put(mask, mask_sharding)
+            v_slice = (None if valid is None
+                       else valid[offset:offset + n_real])
+            offset += n_real
+            if v_slice is not None and v_slice.all():
+                v_slice = None  # fully valid: reuse the cached full mask
+            if n_real < per_host_bs or v_slice is not None:
+                pad = per_host_bs - n_real
+                if pad:
+                    bx = _pad_tail(bx, pad)
+                    if by is not None:
+                        by = _pad_tail(by, pad)
+                mask = np.zeros((per_host_bs,), np.float32)
+                mask[:n_real] = (1.0 if v_slice is None
+                                 else v_slice.astype(np.float32))
+                # multi-host always runs sharded (rounded above), so the
+                # replicated branch only exists single-process
+                mask_dev = dist_lib.put_global(mask, mask_sharding,
+                                               batch_sharded=sharded)
             else:
                 mask_dev = full_mask
             bx, by = self._put_batch(bx, by)
@@ -420,6 +475,9 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def predict(self, dataset_or_x, batch_size: int = 32) -> Any:
+        """Forward the dataset.  ``batch_size`` is global; multi-host, each
+        process feeds its local shard and receives its own rows back (the
+        reference's partition-local predict, Topology.scala:393-397)."""
         self.ensure_initialized()
         if self._predict_step is None:
             self._predict_step = self._build_predict_step()
@@ -429,18 +487,30 @@ class Trainer:
             ds = Dataset.from_ndarray(dataset_or_x)
         outs = []
         n = ds.size
-        for bx, _ in ds.batches(batch_size, shuffle=False,
+        nproc = dist_lib.process_count()
+        per_host_bs = max(batch_size // nproc, 1)
+        if nproc > 1:
+            # same rounding as evaluate: the pod must run sharded
+            dp = mesh_lib.dp_size(self.mesh)
+            if dp % nproc != 0:
+                raise ValueError(
+                    f"data-parallel degree ({dp}) must be a multiple of "
+                    f"the process count ({nproc}) for multi-host predict")
+            local_dp = dp // nproc
+            per_host_bs = -(-per_host_bs // local_dp) * local_dp
+        for bx, _ in ds.batches(per_host_bs, shuffle=False,
                                 drop_remainder=False):
             pad = 0
             first = bx[0] if isinstance(bx, (tuple, list)) else bx
-            if len(first) < batch_size:
+            if len(first) < per_host_bs:
                 # pad the trailing batch to keep one compiled shape
-                pad = batch_size - len(first)
+                pad = per_host_bs - len(first)
                 bx = _pad_tail(bx, pad)
             bx, _ = self._put_batch(bx, None)
             y = self._predict_step(self.state.params, self.state.model_state,
                                    bx)
-            y = jax.device_get(y)
+            # multi-host: fetch only the rows this host fed
+            y = jax.tree_util.tree_map(dist_lib.local_rows, y)
             if pad:
                 y = jax.tree_util.tree_map(lambda a: a[:-pad], y)
             outs.append(y)

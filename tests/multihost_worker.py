@@ -1,0 +1,66 @@
+"""Worker process for the multi-host pod tests (launched by
+test_multihost.py): joins a 2-process jax.distributed CPU cluster, trains a
+small model through the framework's full per-host-feeding path, and dumps
+final params + losses + eval metrics for the parent to compare against a
+single-process run.
+
+Env contract (set by the parent): JAX_PLATFORMS=cpu, XLA_FLAGS with
+--xla_force_host_platform_device_count, ZOO_TPU_COORDINATOR /
+ZOO_TPU_NUM_PROCESSES / ZOO_TPU_PROCESS_ID.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def build_and_train(out_path: str):
+    import jax
+    from analytics_zoo_tpu.common.context import init_nncontext
+    from analytics_zoo_tpu.data.dataset import Dataset
+    from analytics_zoo_tpu.train.trainer import Trainer
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+    from analytics_zoo_tpu.pipeline.api.keras.metrics import Accuracy
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    import optax
+
+    ctx = init_nncontext(app_name="multihost-test")
+    model = Sequential()
+    model.add(Dense(16, activation="relu", input_shape=(8,)))
+    model.add(Dense(4))
+    model = model.to_graph()
+    trainer = Trainer(model,
+                      objectives.get("sparse_categorical_crossentropy"),
+                      optax.sgd(0.1), metrics=[Accuracy()],
+                      mesh=ctx.mesh, strategy="replicate", seed=0)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 4, 64).astype(np.int32)
+    ds = Dataset.from_ndarray(x, y)
+    if jax.process_count() > 1:
+        ds = ds.shard_by_process()
+
+    hist = trainer.fit(ds, batch_size=16, shuffle=False)
+    results = trainer.evaluate(ds, batch_size=16)
+    preds = trainer.predict(ds, batch_size=16)
+
+    params_flat = {
+        "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                 for k in path): np.asarray(jax.device_get(leaf))
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            trainer.state.params)[0]}
+    np.savez(out_path, losses=np.asarray(hist["loss"]),
+             preds=np.asarray(preds),
+             **{f"param:{k}": v for k, v in params_flat.items()})
+    with open(out_path + ".json", "w") as f:
+        json.dump({"eval": results,
+                   "process_count": jax.process_count(),
+                   "global_devices": jax.device_count()}, f)
+
+
+if __name__ == "__main__":
+    build_and_train(sys.argv[1])

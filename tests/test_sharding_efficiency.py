@@ -73,3 +73,18 @@ def test_dryrun_multichip_log_is_clean():
     assert "Involuntary full rematerialization" not in out, (
         "GSPMD remat warnings are back:\n"
         + "\n".join(l for l in out.splitlines() if "SPMD" in l)[:2000])
+
+
+@pytest.mark.slow
+def test_dryrun_multihost_two_processes():
+    """num_processes>1 dryrun variant (VERDICT r2 #1): a real 2-process
+    jax.distributed cluster jits the full fsdp_tp-sharded train step over
+    the global mesh with per-host feeding and agrees on the loss."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multihost(2, 4)"],
+        capture_output=True, text=True, timeout=600,
+        cwd=REPO_ROOT)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-2000:]
+    assert "agreed across 2 processes OK" in out
