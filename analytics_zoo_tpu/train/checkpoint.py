@@ -4,12 +4,24 @@ Parity surface: ``setCheckpoint(path, overWrite)`` + epoch-trigger snapshots
 (reference: Topology.scala:184-194, NNEstimator.scala:301-307) and
 saveModel/loadModel weight round-trips (ZooModel.scala:78-82).
 
-Format: one ``.npz`` of flattened leaves (keyed by pytree path) + a JSON
-manifest.  Restore fills a template pytree (obtained from a fresh init), so
-arbitrary optax states round-trip without pickling.  Saves can run on a
-background thread (``async_save``) — the TPU keeps training while the host
-writes, which is the failure-recovery story SURVEY §5 prescribes for SPMD
-(no Spark lineage to lean on).
+Two formats:
+
+* Flat (``save_checkpoint``): one ``.npz`` of flattened leaves (keyed by
+  pytree path) + a JSON manifest.  Restore fills a template pytree
+  (obtained from a fresh init), so arbitrary optax states round-trip
+  without pickling.
+* Sharded (``save_sharded``): each process writes ONLY its addressable,
+  replica-0 device shards (``ckpt_<tag>.shard-p<rank>.npz``) — no
+  host-0 gather, bounded host memory, and the natural multi-host format
+  (every pod process writes in parallel to a shared filesystem).  Restore
+  reassembles global leaves from all shard files and re-places them under
+  *target* shardings, so a checkpoint taken on one mesh shape restores
+  onto a different one (fsdp → pure-data, 8 devices → 4, ...).
+
+Saves can run on a background thread (``async_save``/
+``async_save_sharded``) — the TPU keeps training while the host writes,
+which is the failure-recovery story SURVEY §5 prescribes for SPMD (no
+Spark lineage to lean on).
 """
 
 from __future__ import annotations
@@ -26,13 +38,15 @@ import jax
 
 
 def _flatten_with_names(tree):
+    """Leaves are returned AS-IS (no host transfer) — sharded leaves of a
+    pod-wide array must not be gathered here."""
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     names, leaves = [], []
     for path, leaf in flat:
         name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
                         for k in path)
         names.append(name or "leaf")
-        leaves.append(np.asarray(leaf))
+        leaves.append(leaf)
     return names, leaves, treedef
 
 
@@ -44,7 +58,7 @@ def save_checkpoint(directory: str, tag: Any, tree, overwrite: bool = True,
         raise FileExistsError(f"{path} exists and overwrite=False "
                               "(reference setCheckpoint overWrite semantics)")
     names, leaves, _ = _flatten_with_names(tree)
-    arrays = {f"arr_{i}": leaf for i, leaf in enumerate(leaves)}
+    arrays = {f"arr_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     tmp = path + ".tmp.npz"
     np.savez(tmp, **arrays)
     os.replace(tmp, path)
@@ -91,13 +105,14 @@ atexit.register(wait_pending)
 def latest_tag(directory: str) -> Optional[str]:
     if not os.path.isdir(directory):
         return None
-    tags = []
+    tags = set()
     for f in os.listdir(directory):
         if f.endswith(".tmp.npz"):  # in-flight/aborted atomic write
             continue
-        m = re.match(r"ckpt_(.+)\.npz$", f)
+        m = re.match(r"ckpt_(.+?)(\.shard-p\d+)?\.npz$", f)
         if m:
-            tags.append(m.group(1))
+            tags.add(m.group(1))
+    tags = sorted(tags)
     if not tags:
         return None
 
@@ -131,3 +146,241 @@ def read_meta(directory: str, tag: Any = None) -> dict:
     tag = tag if tag is not None else latest_tag(directory)
     with open(os.path.join(directory, f"ckpt_{tag}.json")) as f:
         return json.load(f).get("meta", {})
+
+
+# ------------------------------------------------------------- sharded ----
+
+def _none_leaf(x):
+    return x is None
+
+
+def _flatten_none_aware(tree):
+    """Flatten keeping structural ``None`` nodes AS leaves — save and
+    restore must agree on leaf indices even for trees containing None
+    (e.g. optax.masked / inject_hyperparams states)."""
+    return jax.tree_util.tree_flatten(tree, is_leaf=_none_leaf)
+
+
+def _encode_index(index, shape):
+    """Slice tuple (global coords) -> 'start:stop,start:stop,...'."""
+    parts = []
+    for sl, dim in zip(index, shape):
+        parts.append(f"{sl.start or 0}:{dim if sl.stop is None else sl.stop}")
+    return ",".join(parts)
+
+
+def _decode_index(text):
+    if not text:
+        return ()
+    return tuple(slice(int(a), int(b))
+                 for a, b in (p.split(":") for p in text.split(",")))
+
+
+def _host_shards(leaf):
+    """Yield (index, np_array) for the unique (replica-0) device shards of
+    ``leaf`` addressable from this process.  Plain host arrays yield one
+    full-extent shard on process 0 only."""
+    shape = np.shape(leaf)
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        for s in leaf.addressable_shards:
+            if s.replica_id != 0:  # replicated copy — someone else saves it
+                continue
+            index = s.index if s.index else tuple(
+                slice(0, d) for d in shape)
+            yield index, np.asarray(s.data)
+    elif jax.process_index() == 0:
+        yield tuple(slice(0, d) for d in shape), np.asarray(leaf)
+
+
+def _snapshot_shards(tree):
+    """Synchronously copy this process's shards to host memory (so the
+    training loop may donate/overwrite the device buffers immediately)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree, is_leaf=_none_leaf)[0]
+    names = ["/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                      for k in path) or "leaf" for path, _ in flat]
+    leaves = [leaf for _, leaf in flat]
+    arrays = {}
+    shapes, dtypes = [], []
+    for i, leaf in enumerate(leaves):
+        if leaf is None:  # structural None: keeps the index, stores nothing
+            shapes.append(None)
+            dtypes.append(None)
+            continue
+        shapes.append(list(np.shape(leaf)))
+        dtypes.append(str(getattr(leaf, "dtype", np.asarray(leaf).dtype)))
+        for index, data in _host_shards(leaf):
+            arrays[f"{i}|{_encode_index(index, np.shape(leaf))}"] = data
+    return names, shapes, dtypes, arrays
+
+
+def _write_shards(directory: str, tag: Any, pid: int, n_processes: int,
+                  names, shapes, dtypes, arrays,
+                  meta: Optional[dict], overwrite: bool = True) -> str:
+    """The single on-disk writer for the sharded format (used by both the
+    sync and async paths).  Process 0 writes the manifest, which records
+    ``n_processes`` so restore reads EXACTLY that shard-file set — stale
+    files from an earlier save with a larger pod are ignored."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"ckpt_{tag}.shard-p{pid}.npz")
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(f"{path} exists and overwrite=False "
+                              "(reference setCheckpoint overWrite semantics)")
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+    if pid == 0:
+        manifest = {"format": "sharded", "tag": str(tag),
+                    "meta": meta or {}, "n_processes": n_processes,
+                    "names": names, "shapes": shapes, "dtypes": dtypes}
+        with open(os.path.join(directory, f"ckpt_{tag}.json"), "w") as f:
+            json.dump(manifest, f)
+    return path
+
+
+def _pod_barrier(name: str):
+    """Block until every pod process reaches this point (no-op
+    single-process).  Must be called from the main thread by ALL
+    processes."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def save_sharded(directory: str, tag: Any, tree, overwrite: bool = True,
+                 meta: Optional[dict] = None) -> str:
+    """Write this process's addressable shards of every leaf.  Every pod
+    process calls this concurrently; process 0 additionally writes the
+    manifest.  Replicated leaves are deduplicated via ``replica_id == 0``
+    so each byte is stored exactly once across the pod.  Returns after ALL
+    processes have written (pod barrier), so a restore anywhere on the pod
+    immediately after is safe."""
+    names, shapes, dtypes, arrays = _snapshot_shards(tree)
+    path = _write_shards(directory, tag, jax.process_index(),
+                         jax.process_count(), names, shapes, dtypes,
+                         arrays, meta, overwrite)
+    _pod_barrier(f"zoo_ckpt_{tag}")
+    return path
+
+
+def async_save_sharded(directory: str, tag: Any, tree,
+                       meta: Optional[dict] = None):
+    """Sharded analog of ``async_save``: device→host copy happens now,
+    file writes happen on a daemon thread.  NOTE: join via
+    ``wait_pending`` (local) and, on a pod, a cross-process barrier before
+    restoring — ``Trainer.fit`` does both when it returns."""
+    names, shapes, dtypes, arrays = _snapshot_shards(tree)
+    pid, nproc = jax.process_index(), jax.process_count()
+    t = threading.Thread(
+        target=_write_shards,
+        args=(directory, tag, pid, nproc, names, shapes, dtypes, arrays,
+              meta), daemon=True)
+    t.start()
+    _PENDING.append((os.path.abspath(directory), t))
+    return t
+
+
+def restore_sharded(directory: str, template, tag: Any = None,
+                    shardings=None):
+    """Reassemble global leaves from every process's shard files and place
+    them under ``shardings`` (a pytree of NamedSharding matching
+    ``template``; None leaves — or ``shardings=None`` — return host numpy).
+
+    Because the on-disk format is mesh-agnostic (global indices), a
+    checkpoint saved under one mesh/strategy restores onto ANY other —
+    the re-sharding story SURVEY §5 prescribes.  Requires all shard files
+    to be visible (shared filesystem on a pod)."""
+    tag = tag if tag is not None else latest_tag(directory)
+    if tag is None:
+        raise FileNotFoundError(f"No checkpoints in {directory}")
+    # the manifest records how many processes wrote this save; reading
+    # exactly that set ignores stale shard files from an older save of
+    # the same tag under a larger pod
+    n_saved = None
+    manifest_path = os.path.join(directory, f"ckpt_{tag}.json")
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            n_saved = json.load(f).get("n_processes")
+    if n_saved is not None:
+        shard_files = [f"ckpt_{tag}.shard-p{p}.npz" for p in range(n_saved)]
+        missing = [f for f in shard_files
+                   if not os.path.exists(os.path.join(directory, f))]
+        if missing:
+            raise ValueError(
+                f"checkpoint {tag} was written by {n_saved} processes but "
+                f"{missing} are absent (is the checkpoint directory "
+                "shared across all pod processes?)")
+    else:
+        shard_files = sorted(
+            f for f in os.listdir(directory)
+            if re.match(rf"ckpt_{re.escape(str(tag))}\.shard-p\d+\.npz$",
+                        f))
+    if not shard_files:
+        # fall back to the flat format for old checkpoints (then place
+        # under the same target shardings)
+        tree = restore_checkpoint(directory, template, tag)
+        return _place_tree(tree, shardings)
+    flat, treedef = _flatten_none_aware(template)
+    buffers: list = [None] * len(flat)
+    filled = [0] * len(flat)
+    for fname in shard_files:
+        with np.load(os.path.join(directory, fname)) as data:
+            for key in data.files:
+                si, _, idx_text = key.partition("|")
+                i = int(si)
+                tmpl = flat[i]
+                shape = np.shape(tmpl)
+                piece = data[key]
+                if buffers[i] is None:
+                    buffers[i] = np.empty(
+                        shape, getattr(tmpl, "dtype", piece.dtype))
+                index = _decode_index(idx_text)
+                if not index:
+                    buffers[i] = piece  # scalar leaf
+                    filled[i] = 1
+                    continue
+                buffers[i][index] = piece
+                filled[i] += piece.size
+    for i, (tmpl, buf) in enumerate(zip(flat, buffers)):
+        if tmpl is None:
+            continue  # structural None leaf — nothing stored
+        if buf is None:
+            raise ValueError(
+                f"checkpoint {tag} is missing data for leaf {i} "
+                f"(shape {np.shape(tmpl)}) — incomplete shard set?")
+        want = int(np.prod(np.shape(tmpl))) if np.shape(tmpl) else 1
+        if filled[i] < want:
+            raise ValueError(
+                f"checkpoint {tag} leaf {i} only has {filled[i]}/{want} "
+                "elements — missing shard files (is the checkpoint "
+                "directory shared across all pod processes?)")
+        if np.shape(buf) != np.shape(tmpl):
+            raise ValueError(
+                f"Leaf shape mismatch: {np.shape(tmpl)} vs {np.shape(buf)}")
+    return _place_tree(jax.tree_util.tree_unflatten(treedef, buffers),
+                       shardings)
+
+
+def _place_tree(tree, shardings):
+    """Place host leaves under target shardings (None leaves / None tree
+    stay on host).  ``make_array_from_callback`` hands each device only
+    its own slice, so a pod-wide array never materializes per-device
+    copies of the full leaf."""
+    if shardings is None:
+        return tree
+    # BOTH trees flatten None-aware so structural Nones cannot shift the
+    # (leaf, sharding) pairing
+    flat, treedef = _flatten_none_aware(tree)
+    shard_flat = _flatten_none_aware(shardings)[0]
+    if len(flat) != len(shard_flat):
+        raise ValueError(
+            f"shardings tree has {len(shard_flat)} leaves, value tree has "
+            f"{len(flat)} — structures must match")
+    placed = []
+    for buf, sh in zip(flat, shard_flat):
+        if sh is None or buf is None:
+            placed.append(buf)
+        else:
+            buf = np.asarray(buf)
+            placed.append(jax.make_array_from_callback(
+                np.shape(buf), sh, lambda idx, b=buf: b[idx]))
+    return jax.tree_util.tree_unflatten(treedef, placed)

@@ -34,7 +34,7 @@ from ..parallel import distributed as dist_lib
 from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
 from . import triggers as trigger_lib
-from .checkpoint import async_save
+from .checkpoint import async_save_sharded
 from .checkpoint import wait_pending as checkpoint_lib_wait_pending
 from .summary import TrainSummary, ValidationSummary
 
@@ -148,13 +148,17 @@ class Trainer:
         init_rng, loop_rng = jax.random.split(rng)
         params, model_state = self.model.init(
             init_rng, getattr(self.model, "batch_input_shape", None))
-        opt_state = self.optimizer.init(params)
-        # place according to strategy; XLA keeps them there across steps
+        # place according to strategy; XLA keeps them there across steps.
+        # The optimizer state is initialized from the PLACED params so its
+        # moment buffers inherit the same shardings (fsdp shards optimizer
+        # state alongside params, ZeRO-style) — init-before-placement
+        # would pin momentum to one device and conflict after a restore.
         self._param_shardings = sharding_lib.shard_params(
             params, self.mesh, self.strategy)
         params = jax.tree_util.tree_map(
             lambda p, s: jax.device_put(p, s), params, self._param_shardings)
         model_state = jax.device_put(model_state, self._repl_sharding)
+        opt_state = self.optimizer.init(params)
         self.state = TrainState(params, model_state, opt_state,
                                 rng=loop_rng)
 
@@ -348,8 +352,9 @@ class Trainer:
                 if self._ckpt_path and not isinstance(
                         self._ckpt_trigger, trigger_lib.EveryEpoch) \
                         and self._ckpt_trigger(it_record):
-                    async_save(self._ckpt_path, st.step, st.as_tree(),
-                               meta={"step": st.step, "epoch": st.epoch})
+                    async_save_sharded(
+                        self._ckpt_path, st.step, st.as_tree(),
+                        meta={"step": st.step, "epoch": st.epoch})
                 if end_trigger(it_record):
                     # remember the firing so the outer loop terminates even
                     # for triggers the outer record can't re-evaluate
@@ -396,13 +401,17 @@ class Trainer:
                     print(f"[zoo-tpu]   validation: {results}")
             if self._ckpt_path and isinstance(self._ckpt_trigger,
                                               trigger_lib.EveryEpoch):
-                async_save(self._ckpt_path, f"epoch{st.epoch}",
-                           st.as_tree(),
-                           meta={"step": st.step, "epoch": st.epoch})
+                async_save_sharded(self._ckpt_path, f"epoch{st.epoch}",
+                                   st.as_tree(),
+                                   meta={"step": st.step,
+                                         "epoch": st.epoch})
         if self._ckpt_path:
             # fit returning means "checkpoints are on disk" — join the
-            # async writers so callers can immediately restore
+            # async writers, then barrier so EVERY pod process's shards
+            # are on disk before any process restores
             checkpoint_lib_wait_pending(self._ckpt_path)
+            from .checkpoint import _pod_barrier
+            _pod_barrier("zoo_fit_ckpt_done")
         return history
 
     # ------------------------------------------------------------------
@@ -522,17 +531,37 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def save_weights(self, directory: str, tag="final"):
-        from .checkpoint import save_checkpoint
+        """Per-shard save: each pod process writes only its addressable
+        shards (no host-0 gather) — SURVEY §5's sharded-TrainState story."""
+        from .checkpoint import save_sharded
         self.ensure_initialized()
-        save_checkpoint(directory, tag, jax.device_get(
-            self.state.as_tree()),
-            meta={"step": self.state.step, "epoch": self.state.epoch})
+        save_sharded(directory, tag, self.state.as_tree(),
+                     meta={"step": self.state.step,
+                           "epoch": self.state.epoch})
 
     def load_weights(self, directory: str, tag=None):
-        from .checkpoint import restore_checkpoint, read_meta
+        """Restore with RE-SHARDING: the checkpoint's global leaves are
+        re-placed under this trainer's shardings, so a snapshot taken on a
+        different mesh shape or strategy restores cleanly."""
+        from .checkpoint import restore_sharded, read_meta
+        from jax.sharding import NamedSharding
         self.ensure_initialized()
-        tree = restore_checkpoint(directory, self.state.as_tree(), tag)
-        self.state.load_tree(jax.device_put(tree))
+        template = self.state.as_tree()
+
+        def target_sharding(l):
+            if not isinstance(l, jax.Array):
+                return None
+            # leaves born off-mesh (e.g. optax's scalar step count gets a
+            # SingleDeviceSharding at init) must land replicated on the
+            # mesh, or the restored state pins jit to one device
+            if isinstance(l.sharding, NamedSharding):
+                return l.sharding
+            return self._repl_sharding
+
+        shardings = jax.tree_util.tree_map(target_sharding, template)
+        tree = restore_sharded(directory, template, tag,
+                               shardings=shardings)
+        self.state.load_tree(tree)
         meta = read_meta(directory, tag)
         self.state.step = int(meta.get("step", self.state.step))
         self.state.epoch = int(meta.get("epoch", self.state.epoch))

@@ -28,10 +28,14 @@ def build_and_train(out_path: str):
     import optax
 
     ctx = init_nncontext(app_name="multihost-test")
-    model = Sequential()
-    model.add(Dense(16, activation="relu", input_shape=(8,)))
-    model.add(Dense(4))
-    model = model.to_graph()
+
+    def make_graph():
+        m = Sequential()
+        m.add(Dense(16, activation="relu", input_shape=(8,)))
+        m.add(Dense(4))
+        return m.to_graph()
+
+    model = make_graph()
     trainer = Trainer(model,
                       objectives.get("sparse_categorical_crossentropy"),
                       optax.sgd(0.1), metrics=[Accuracy()],
@@ -47,6 +51,20 @@ def build_and_train(out_path: str):
     hist = trainer.fit(ds, batch_size=16, shuffle=False)
     results = trainer.evaluate(ds, batch_size=16)
     preds = trainer.predict(ds, batch_size=16)
+
+    # sharded checkpoint on the pod: every process writes its own shard
+    # file (save_weights barriers pod-wide), then a FRESH trainer restores
+    # (re-placing under its shardings) and must predict identically
+    ckpt_dir = os.path.join(os.path.dirname(os.path.abspath(out_path)),
+                            "shared_ckpt")
+    trainer.save_weights(ckpt_dir)
+    trainer2 = Trainer(make_graph(),
+                       objectives.get("sparse_categorical_crossentropy"),
+                       optax.sgd(0.1), metrics=[Accuracy()],
+                       mesh=ctx.mesh, strategy="replicate", seed=0)
+    trainer2.load_weights(ckpt_dir)
+    preds2 = trainer2.predict(ds, batch_size=16)
+    np.testing.assert_allclose(preds, preds2, rtol=1e-5, atol=1e-6)
 
     params_flat = {
         "/".join(str(getattr(k, "key", getattr(k, "idx", k)))

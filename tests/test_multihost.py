@@ -49,9 +49,16 @@ def _run_cluster(tmp_path, n_proc=2, devices_per_proc=4, timeout=420):
             [sys.executable, WORKER, out], env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     logs = []
-    for p in procs:
-        stdout, _ = p.communicate(timeout=timeout)
-        logs.append(stdout)
+    try:
+        for p in procs:
+            stdout, _ = p.communicate(timeout=timeout)
+            logs.append(stdout)
+    except subprocess.TimeoutExpired:
+        # a worker stuck in a gloo barrier never exits on its own
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
     for pid, (p, log_text) in enumerate(zip(procs, logs)):
         assert p.returncode == 0, (
             f"worker {pid} failed (rc={p.returncode}):\n{log_text}")

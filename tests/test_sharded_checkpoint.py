@@ -1,0 +1,171 @@
+"""Sharded checkpointing: per-shard save (no host-0 gather), restore with
+re-sharding onto a different mesh shape, async writer, fit-resume under
+fsdp.
+
+Parity: the reference's epoch-trigger checkpoints (Topology.scala:184-194)
++ SURVEY §5's prescription of sharded TrainState snapshots for SPMD
+failure recovery (no Spark lineage to lean on).
+"""
+
+import os
+
+import numpy as np
+import optax
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+from analytics_zoo_tpu.train.checkpoint import (
+    async_save_sharded, restore_sharded, read_meta, save_sharded,
+    wait_pending)
+
+
+def _tree():
+    rng = np.random.default_rng(0)
+    return {"w": rng.normal(size=(16, 8)).astype(np.float32),
+            "b": rng.normal(size=(8,)).astype(np.float32),
+            "step": np.int32(7)}
+
+
+def test_roundtrip_across_mesh_shapes(tmp_path):
+    """Save under {data:2, fsdp:4} with w sharded over fsdp; restore onto
+    {data:8} fully replicated AND onto {data:2, fsdp:2, tensor:2} with a
+    different partitioning — values identical each way."""
+    tree = _tree()
+    mesh1 = mesh_lib.create_mesh({"data": 2, "fsdp": 4})
+    placed = {
+        "w": jax.device_put(tree["w"],
+                            NamedSharding(mesh1, P("fsdp", None))),
+        "b": jax.device_put(tree["b"], NamedSharding(mesh1, P())),
+        "step": tree["step"],
+    }
+    save_sharded(str(tmp_path), "t1", placed, meta={"epoch": 3})
+
+    # restore onto an 8-wide pure-data mesh, replicated
+    mesh2 = mesh_lib.create_mesh({"data": 8})
+    restored = restore_sharded(
+        str(tmp_path), jax.tree_util.tree_map(np.zeros_like, tree), "t1",
+        shardings={"w": NamedSharding(mesh2, P()),
+                   "b": NamedSharding(mesh2, P()), "step": None})
+    np.testing.assert_array_equal(np.asarray(restored["w"]), tree["w"])
+    np.testing.assert_array_equal(np.asarray(restored["b"]), tree["b"])
+    assert int(restored["step"]) == 7
+
+    # restore onto a third mesh with a different partitioning of w
+    mesh3 = mesh_lib.create_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+    restored3 = restore_sharded(
+        str(tmp_path), jax.tree_util.tree_map(np.zeros_like, tree), "t1",
+        shardings={"w": NamedSharding(mesh3, P("tensor", "fsdp")),
+                   "b": NamedSharding(mesh3, P("fsdp")), "step": None})
+    np.testing.assert_array_equal(np.asarray(restored3["w"]), tree["w"])
+    assert restored3["w"].sharding.spec == P("tensor", "fsdp")
+    assert read_meta(str(tmp_path), "t1") == {"epoch": 3}
+
+
+def test_replicated_leaves_stored_once(tmp_path):
+    """replica_id dedup: a fully replicated leaf on 8 devices is written
+    exactly once, not 8 times."""
+    mesh = mesh_lib.create_mesh({"data": 8})
+    placed = {"w": jax.device_put(np.ones((4, 4), np.float32),
+                                  NamedSharding(mesh, P()))}
+    path = save_sharded(str(tmp_path), "t2", placed)
+    with np.load(path) as data:
+        assert len(data.files) == 1
+        assert data[data.files[0]].shape == (4, 4)
+
+
+def test_async_save_sharded_joins(tmp_path):
+    mesh = mesh_lib.create_mesh({"data": 2, "fsdp": 4})
+    placed = {"w": jax.device_put(np.arange(32, dtype=np.float32
+                                            ).reshape(8, 4),
+                                  NamedSharding(mesh, P("fsdp", None)))}
+    async_save_sharded(str(tmp_path), "t3", placed, meta={"step": 1})
+    wait_pending(str(tmp_path))
+    restored = restore_sharded(str(tmp_path),
+                               {"w": np.zeros((8, 4), np.float32)}, "t3")
+    np.testing.assert_array_equal(restored["w"],
+                                  np.arange(32).reshape(8, 4))
+
+
+def test_missing_shard_file_detected(tmp_path):
+    mesh = mesh_lib.create_mesh({"data": 2, "fsdp": 4})
+    placed = {"w": jax.device_put(np.ones((8, 4), np.float32),
+                                  NamedSharding(mesh, P("fsdp", None)))}
+    path = save_sharded(str(tmp_path), "t4", placed)
+    # corrupt: drop half the entries by rewriting the shard file
+    with np.load(path) as data:
+        keys = sorted(data.files)
+        kept = {k: data[k] for k in keys[: len(keys) // 2]}
+    np.savez(path, **kept)
+    with pytest.raises(ValueError, match="elements|missing"):
+        restore_sharded(str(tmp_path), {"w": np.zeros((8, 4), np.float32)},
+                        "t4")
+
+
+def test_stale_shards_from_larger_pod_ignored(tmp_path):
+    """Re-saving a tag with fewer processes must not merge stale shard
+    files left by an earlier larger-pod save: the manifest records
+    n_processes and restore reads exactly that set."""
+    import shutil
+    mesh = mesh_lib.create_mesh({"data": 8})
+    placed = {"w": jax.device_put(np.ones((4, 4), np.float32),
+                                  NamedSharding(mesh, P()))}
+    path = save_sharded(str(tmp_path), "t6", placed)
+    # forge a stale shard file from a hypothetical process 1 of an older,
+    # larger-pod save, holding DIFFERENT data
+    stale = os.path.join(str(tmp_path), "ckpt_t6.shard-p1.npz")
+    np.savez(stale, **{"0|0:4,0:4": np.full((4, 4), 99.0, np.float32)})
+    restored = restore_sharded(str(tmp_path),
+                               {"w": np.zeros((4, 4), np.float32)}, "t6")
+    np.testing.assert_array_equal(restored["w"], np.ones((4, 4)))
+
+
+def test_fit_resume_under_fsdp(tmp_path):
+    """Interrupted fit under the fsdp strategy resumes from the sharded
+    epoch checkpoint and lands on the SAME params as the uninterrupted
+    2-epoch run (epoch counting + shuffle seeds included)."""
+    from analytics_zoo_tpu.data.dataset import Dataset
+    from analytics_zoo_tpu.train.trainer import Trainer
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, objectives
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.train import triggers
+
+    mesh = mesh_lib.create_mesh({"data": 2, "fsdp": 4})
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 4, 64).astype(np.int32)
+    ds = Dataset.from_ndarray(x, y)
+
+    def make_trainer():
+        m = Sequential()
+        m.add(Dense(4096, activation="relu", input_shape=(8,)))
+        m.add(Dense(4))
+        return Trainer(m.to_graph(),
+                       objectives.get("sparse_categorical_crossentropy"),
+                       optax.sgd(0.05, momentum=0.9), mesh=mesh,
+                       strategy="fsdp", seed=0)
+
+    # uninterrupted: 2 epochs
+    t_full = make_trainer()
+    t_full.fit(ds, batch_size=16, end_trigger=triggers.MaxEpoch(2))
+
+    # interrupted: 1 epoch with checkpointing, then resume in a NEW trainer
+    ckpt = str(tmp_path / "ckpt")
+    t_a = make_trainer()
+    t_a.set_checkpoint(ckpt)
+    t_a.fit(ds, batch_size=16, end_trigger=triggers.MaxEpoch(1))
+    t_b = make_trainer()
+    t_b.load_weights(ckpt)  # latest = epoch1, re-sharded onto fsdp
+    assert t_b.state.epoch == 1
+    t_b.fit(ds, batch_size=16, end_trigger=triggers.MaxEpoch(2))
+
+    for (pa, la), (pb, lb) in zip(
+            jax.tree_util.tree_flatten_with_path(t_full.state.params)[0],
+            jax.tree_util.tree_flatten_with_path(t_b.state.params)[0]):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=2e-4, atol=1e-5, err_msg=str(pa))
+    # the resumed trainer's params still carry the fsdp shardings
+    flat = jax.tree_util.tree_leaves(t_b.state.params)
+    assert any(getattr(l.sharding, "spec", P()) != P() for l in flat)
