@@ -74,6 +74,42 @@ class Dataset:
     # iterable of samples already local to this host
     from_rdd = from_iterable
 
+    @classmethod
+    def from_loader(cls, loader) -> "StreamingDataset":
+        """Stream batches from an ``ImageLoader`` (or any object with
+        ``files``-like length that re-iterates (x, y) batches) WITHOUT
+        materializing — training over a folder larger than host RAM
+        (reference streams via sc.binaryFiles, ImageSet.scala:80)."""
+        n = len(getattr(loader, "files", []) or []) or None
+
+        def factory(shuffle, seed, epoch):
+            if hasattr(loader, "shuffle"):
+                loader.shuffle = shuffle
+            if hasattr(loader, "seed") and hasattr(loader, "_epoch"):
+                # deterministic per-epoch order under the loader's own
+                # seed+epoch scheme
+                loader.seed = seed
+                loader._epoch = epoch
+            return iter(loader)
+
+        ds = StreamingDataset(factory, size=n)
+        ds._can_shuffle = hasattr(loader, "shuffle")
+        return ds
+
+    @classmethod
+    def from_batch_iterable(cls, make_iter: Callable[[], Iterable],
+                            size: Optional[int] = None,
+                            steps_per_epoch: Optional[int] = None
+                            ) -> "StreamingDataset":
+        """Stream from any zero-arg factory returning an iterator of
+        (x, y) numpy batches (arbitrary chunk sizes — they are re-batched
+        to the requested batch size).  The factory cannot shuffle; fit's
+        ``shuffle=True`` logs a warning and replays the source order."""
+        ds = StreamingDataset(lambda shuffle, seed, epoch: make_iter(),
+                              size=size, steps_hint=steps_per_epoch)
+        ds._can_shuffle = False
+        return ds
+
     @property
     def size(self) -> int:
         if self._size is None:
@@ -136,10 +172,33 @@ class Dataset:
         return Dataset(self._index(self.x, idx), self._index(self.y, idx),
                        size=per, valid=None if valid.all() else valid)
 
-    def map(self, fn: Callable) -> "Dataset":
-        """Apply fn to every (x, y) pair eagerly (Preprocessing chains from
-        feature/common.py slot in here)."""
+    def map(self, fn: Callable, batched: bool = False,
+            batch_size: int = 4096) -> "Dataset":
+        """Apply fn eagerly (Preprocessing chains from feature/common.py
+        slot in here).
+
+        ``batched=False``: fn maps one (x, y) SAMPLE pair (the reference's
+        per-record Preprocessing contract).  ``batched=True``: fn maps a
+        whole (x_batch, y_batch) pair and is applied in ``batch_size``
+        chunks — one python call per chunk instead of per sample, the
+        right shape for numpy-vectorized transforms at ImageNet scale."""
         n = self.size
+        if batched:
+            xs, ys = [], []
+            for s in range(0, n, batch_size):
+                sel = np.arange(s, min(s + batch_size, n))
+                out = fn((self._index(self.x, sel), self._index(self.y,
+                                                                sel)))
+                xs.append(out[0])
+                ys.append(out[1])
+            cat = lambda parts: (
+                tuple(np.concatenate([p[i] for p in parts])
+                      for i in range(len(parts[0])))
+                if isinstance(parts[0], (tuple, list))
+                else np.concatenate(parts))
+            x = cat(xs)
+            y = cat(ys) if ys[0] is not None else None
+            return Dataset(x, y, size=n, valid=self.valid)
         xs, ys = [], []
         for i in range(n):
             x_i = self._index(self.x, i)
@@ -150,6 +209,155 @@ class Dataset:
         x = _stack_tree(xs)
         y = _stack_tree(ys) if ys[0] is not None else None
         return Dataset(x, y, size=n, valid=self.valid)
+
+
+def _batch_rows(batch) -> int:
+    x = batch[0] if isinstance(batch, tuple) and len(batch) == 2 else batch
+    first = x[0] if isinstance(x, (tuple, list)) else x
+    return len(first)
+
+
+def _batch_concat_all(batches):
+    """Concatenate a list of (x, y) batches tree-wise (y may be None)."""
+    def cat(parts):
+        if parts[0] is None:
+            return None
+        if isinstance(parts[0], (tuple, list)):
+            return tuple(np.concatenate([p[i] for p in parts])
+                         for i in range(len(parts[0])))
+        return np.concatenate(parts)
+    return cat([b[0] for b in batches]), cat([b[1] for b in batches])
+
+
+def _batch_concat(a, b):
+    return _batch_concat_all([a, b])
+
+
+def _batch_slice(batch, start, stop):
+    def sl(u):
+        if u is None:
+            return None
+        if isinstance(u, (tuple, list)):
+            return tuple(ui[start:stop] for ui in u)
+        return u[start:stop]
+    return sl(batch[0]), sl(batch[1])
+
+
+class StreamingDataset(Dataset):
+    """Batches stream from a re-iterable source — NOTHING is materialized
+    beyond the current working window, so a folder larger than host RAM
+    trains in bounded memory (the role sc.binaryFiles streaming plays in
+    the reference, ImageSet.scala:80).
+
+    ``factory(shuffle, seed, epoch)`` returns a fresh iterator of (x, y)
+    numpy batches of ARBITRARY chunk sizes; ``batches()`` re-chunks them
+    to the requested batch size with a small concat buffer.
+    """
+
+    def __init__(self, factory: Callable, size: Optional[int] = None,
+                 steps_hint: Optional[int] = None):
+        super().__init__(None, None, size=size)
+        self._factory = factory
+        self._steps_hint = steps_hint
+        self._maps: List[Callable] = []
+
+    @property
+    def size(self) -> Optional[int]:
+        return self._size  # may be None (unknown until one full pass)
+
+    def map(self, fn: Callable, batched: bool = True) -> "StreamingDataset":
+        """LAZY map: fn is applied to each streamed (x, y) batch at
+        iteration time (``batched=True``, the default here) or to each
+        sample (``batched=False``) — either way nothing materializes."""
+        if batched:
+            wrapped = fn
+        else:
+            def wrapped(batch, _fn=fn):
+                x, y = batch
+                n = _batch_rows(batch)
+
+                def at(u, i):
+                    if u is None:
+                        return None
+                    if isinstance(u, (tuple, list)):
+                        return tuple(ui[i] for ui in u)
+                    return u[i]
+
+                outs = [_fn((at(x, i), at(y, i))) for i in range(n)]
+                xs = _stack_tree([o[0] for o in outs])
+                ys = (_stack_tree([o[1] for o in outs])
+                      if outs and outs[0][1] is not None else None)
+                return xs, ys
+        child = StreamingDataset(self._factory, size=self._size,
+                                 steps_hint=self._steps_hint)
+        child._maps = self._maps + [wrapped]
+        child._can_shuffle = self._can_shuffle
+        return child
+
+    _can_shuffle = True
+    _warned_no_shuffle = False
+
+    def batches(self, batch_size: int, shuffle: bool = False,
+                seed: int = 0, epoch: int = 0, drop_remainder: bool = True,
+                ) -> Iterator[Tuple[Any, Any]]:
+        if shuffle and not self._can_shuffle \
+                and not StreamingDataset._warned_no_shuffle:
+            StreamingDataset._warned_no_shuffle = True
+            import logging
+            logging.getLogger("analytics_zoo_tpu").warning(
+                "this stream source cannot shuffle — every epoch replays "
+                "the source order. Shuffle at the source (ImageLoader "
+                "shuffles; a from_batch_iterable factory cannot).")
+        src = self._factory(shuffle, seed, epoch)
+        # pending chunks + running row count: one concatenate per EMITTED
+        # batch (a grow-the-buffer concat per source chunk would copy the
+        # whole window once per chunk — ~batch/chunk× write amplification
+        # on the thread that keeps the TPU fed)
+        pending: List[Tuple[Any, Any]] = []
+        rows = 0
+        count = 0
+        for chunk in src:
+            if not (isinstance(chunk, tuple) and len(chunk) == 2):
+                chunk = (chunk, None)
+            for fn in self._maps:
+                chunk = fn(chunk)
+            pending.append(chunk)
+            rows += _batch_rows(chunk)
+            while rows >= batch_size:
+                window = pending[0] if len(pending) == 1 else \
+                    _batch_concat_all(pending)
+                pending = []
+                n = _batch_rows(window)
+                start = 0
+                while n - start >= batch_size:
+                    yield _batch_slice(window, start, start + batch_size)
+                    start += batch_size
+                    count += batch_size
+                if start < n:
+                    pending = [_batch_slice(window, start, n)]
+                rows = n - start
+        if rows:
+            count += rows
+            if not drop_remainder:
+                yield (pending[0] if len(pending) == 1
+                       else _batch_concat_all(pending))
+        if self._size is None:
+            self._size = count  # learned after one full pass
+
+    def steps_per_epoch(self, batch_size: int,
+                        drop_remainder: bool = True) -> int:
+        if self._size is not None:
+            return super().steps_per_epoch(batch_size, drop_remainder)
+        if self._steps_hint is not None:
+            return self._steps_hint
+        raise ValueError("unknown stream length — pass steps_per_epoch to "
+                         "from_batch_iterable or iterate one epoch first")
+
+    def shard_by_process(self, process_index=None, process_count=None):
+        raise NotImplementedError(
+            "shard a stream at the source (give each host its own file "
+            "list / loader) rather than wrapping shard_by_process around "
+            "it")
 
 
 def check_batch_divisibility(batch_size: int, dp: int, n_processes: int = 1):

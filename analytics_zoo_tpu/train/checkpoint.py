@@ -255,10 +255,15 @@ def save_sharded(directory: str, tag: Any, tree, overwrite: bool = True,
     processes have written (pod barrier), so a restore anywhere on the pod
     immediately after is safe."""
     names, shapes, dtypes, arrays = _snapshot_shards(tree)
-    path = _write_shards(directory, tag, jax.process_index(),
-                         jax.process_count(), names, shapes, dtypes,
-                         arrays, meta, overwrite)
-    _pod_barrier(f"zoo_ckpt_{tag}")
+    try:
+        path = _write_shards(directory, tag, jax.process_index(),
+                             jax.process_count(), names, shapes, dtypes,
+                             arrays, meta, overwrite)
+    finally:
+        # the barrier must run on EVERY process even when this one's
+        # write raises (e.g. overwrite=False and the file exists) —
+        # skipping it would leave the rest of the pod blocked forever
+        _pod_barrier(f"zoo_ckpt_{tag}")
     return path
 
 
@@ -320,44 +325,72 @@ def restore_sharded(directory: str, template, tag: Any = None,
         tree = restore_checkpoint(directory, template, tag)
         return _place_tree(tree, shardings)
     flat, treedef = _flatten_none_aware(template)
-    buffers: list = [None] * len(flat)
-    filled = [0] * len(flat)
-    for fname in shard_files:
-        with np.load(os.path.join(directory, fname)) as data:
-            for key in data.files:
+    shard_flat = ([None] * len(flat) if shardings is None
+                  else _flatten_none_aware(shardings)[0])
+    if len(shard_flat) != len(flat):
+        raise ValueError(
+            f"shardings tree has {len(shard_flat)} leaves, template has "
+            f"{len(flat)} — structures must match")
+    # index every entry key by leaf (npz members load lazily, so this
+    # only reads the zip directories), then assemble + place ONE leaf at
+    # a time — restore stays bounded by the largest leaf, not the whole
+    # state (the same bounded-memory property save has)
+    handles = [np.load(os.path.join(directory, f)) for f in shard_files]
+    try:
+        by_leaf: dict = {}
+        for h in handles:
+            for key in h.files:
                 si, _, idx_text = key.partition("|")
                 i = int(si)
-                tmpl = flat[i]
-                shape = np.shape(tmpl)
-                piece = data[key]
-                if buffers[i] is None:
-                    buffers[i] = np.empty(
-                        shape, getattr(tmpl, "dtype", piece.dtype))
+                if i >= len(flat):
+                    raise ValueError(
+                        f"checkpoint {tag} has a leaf index {i} but the "
+                        f"template has only {len(flat)} leaves — model/"
+                        "optimizer structure changed since the save?")
+                by_leaf.setdefault(i, []).append((h, key, idx_text))
+        placed = []
+        for i, (tmpl, sh) in enumerate(zip(flat, shard_flat)):
+            if tmpl is None:
+                placed.append(None)
+                continue
+            entries = by_leaf.get(i)
+            if not entries:
+                raise ValueError(
+                    f"checkpoint {tag} is missing data for leaf {i} "
+                    f"(shape {np.shape(tmpl)}) — incomplete shard set?")
+            shape = np.shape(tmpl)
+            buf = None
+            filled = 0
+            for h, key, idx_text in entries:
+                piece = h[key]
                 index = _decode_index(idx_text)
-                if not index:
-                    buffers[i] = piece  # scalar leaf
-                    filled[i] = 1
+                if not index:  # scalar leaf
+                    buf, filled = piece, 1
                     continue
-                buffers[i][index] = piece
-                filled[i] += piece.size
-    for i, (tmpl, buf) in enumerate(zip(flat, buffers)):
-        if tmpl is None:
-            continue  # structural None leaf — nothing stored
-        if buf is None:
-            raise ValueError(
-                f"checkpoint {tag} is missing data for leaf {i} "
-                f"(shape {np.shape(tmpl)}) — incomplete shard set?")
-        want = int(np.prod(np.shape(tmpl))) if np.shape(tmpl) else 1
-        if filled[i] < want:
-            raise ValueError(
-                f"checkpoint {tag} leaf {i} only has {filled[i]}/{want} "
-                "elements — missing shard files (is the checkpoint "
-                "directory shared across all pod processes?)")
-        if np.shape(buf) != np.shape(tmpl):
-            raise ValueError(
-                f"Leaf shape mismatch: {np.shape(tmpl)} vs {np.shape(buf)}")
-    return _place_tree(jax.tree_util.tree_unflatten(treedef, buffers),
-                       shardings)
+                if buf is None:
+                    buf = np.empty(shape,
+                                   getattr(tmpl, "dtype", piece.dtype))
+                buf[index] = piece
+                filled += piece.size
+            want = int(np.prod(shape)) if shape else 1
+            if filled < want:
+                raise ValueError(
+                    f"checkpoint {tag} leaf {i} only has {filled}/{want} "
+                    "elements — missing shard files (is the checkpoint "
+                    "directory shared across all pod processes?)")
+            if np.shape(buf) != shape:
+                raise ValueError(
+                    f"Leaf shape mismatch: {shape} vs {np.shape(buf)}")
+            if sh is None:
+                placed.append(buf)
+            else:
+                placed.append(jax.make_array_from_callback(
+                    shape, sh, lambda idx, b=buf: b[idx]))
+            del buf  # free before assembling the next leaf
+    finally:
+        for h in handles:
+            h.close()
+    return jax.tree_util.tree_unflatten(treedef, placed)
 
 
 def _place_tree(tree, shardings):
