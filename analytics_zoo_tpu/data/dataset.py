@@ -229,10 +229,6 @@ def _batch_concat_all(batches):
     return cat([b[0] for b in batches]), cat([b[1] for b in batches])
 
 
-def _batch_concat(a, b):
-    return _batch_concat_all([a, b])
-
-
 def _batch_slice(batch, start, stop):
     def sl(u):
         if u is None:
@@ -265,10 +261,12 @@ class StreamingDataset(Dataset):
     def size(self) -> Optional[int]:
         return self._size  # may be None (unknown until one full pass)
 
-    def map(self, fn: Callable, batched: bool = True) -> "StreamingDataset":
-        """LAZY map: fn is applied to each streamed (x, y) batch at
-        iteration time (``batched=True``, the default here) or to each
-        sample (``batched=False``) — either way nothing materializes."""
+    def map(self, fn: Callable, batched: bool = False
+            ) -> "StreamingDataset":
+        """LAZY map: fn is applied to each sample (``batched=False``, the
+        same contract as ``Dataset.map``) or to each streamed (x, y)
+        batch (``batched=True`` — one python call per chunk) — either way
+        nothing materializes."""
         if batched:
             wrapped = fn
         else:

@@ -87,7 +87,7 @@ class ImageLoader:
                  std: Optional[Sequence[float]] = None,
                  scale: float = 1.0, shuffle: bool = False, seed: int = 0,
                  num_threads: int = 0, drop_remainder: bool = False,
-                 prefetch: int = 2):
+                 prefetch: int = 2, out_dtype: str = "float32"):
         self.files = list(files)
         self.labels = labels if labels is None else np.asarray(labels)
         if self.labels is not None and len(self.labels) != len(self.files):
@@ -100,6 +100,18 @@ class ImageLoader:
         self.drop_remainder = drop_remainder
         self.prefetch = max(int(prefetch), 1)
         self._epoch = 0
+        # out_dtype="uint8": emit raw resized pixels and DEFER
+        # normalization to the device — a 4x smaller host→device transfer
+        # (the normalize belongs in the jit'd step; see bench.py)
+        if out_dtype not in ("float32", "uint8"):
+            raise ValueError(f"unsupported out_dtype {out_dtype!r}")
+        if out_dtype == "uint8" and (mean is not None or std is not None
+                                     or scale != 1.0):
+            raise ValueError(
+                "out_dtype='uint8' emits RAW pixels — normalization "
+                "(mean/std/scale) must be applied on-device by the "
+                "consumer; passing it here would be silently dropped")
+        self.out_dtype = out_dtype
 
     @classmethod
     def from_folder(cls, path: str, with_label: bool = True, **kw
@@ -116,6 +128,25 @@ class ImageLoader:
         return (n + self.batch_size - 1) // self.batch_size
 
     def _decode(self, blobs: List[bytes]) -> np.ndarray:
+        if self.out_dtype == "uint8":
+            if native.available():
+                # the native decoder emits float32; the cast-down costs a
+                # host pass (~4 bytes/px) — only the host→device transfer
+                # shrinks.  A native uint8 output mode would remove it.
+                raw = native.decode_resize_normalize_batch(
+                    blobs, self.size, mean=None, std=None, scale=1.0,
+                    num_threads=self.num_threads)
+                return raw.astype(np.uint8)
+            import io
+            from PIL import Image
+            h, w = self.size
+            out = np.empty((len(blobs), h, w, 3), np.uint8)
+            for i, raw in enumerate(blobs):
+                img = Image.open(io.BytesIO(raw)).convert("RGB")
+                if img.size != (w, h):
+                    img = img.resize((w, h), Image.BILINEAR)
+                out[i] = np.asarray(img, np.uint8)
+            return out
         if native.available():
             return native.decode_resize_normalize_batch(
                 blobs, self.size, mean=self.mean, std=self.std,

@@ -75,7 +75,7 @@ def test_streaming_memory_bounded():
 
 def test_streaming_lazy_map():
     ds = Dataset.from_batch_iterable(lambda: _chunks([4, 4]), size=8)
-    doubled = ds.map(lambda b: (b[0] * 2, b[1]))  # batched (default)
+    doubled = ds.map(lambda b: (b[0] * 2, b[1]), batched=True)
     got = np.concatenate([b[0] for b in doubled.batches(4)])
     np.testing.assert_array_equal(got[:, 0], np.arange(8) * 2.0)
     per_sample = ds.map(lambda s: (s[0] + 1.0, s[1]), batched=False)
@@ -92,6 +92,26 @@ def _write_image_folder(root, n_per_class=12, size=(10, 10)):
         for i in range(n_per_class):
             arr = rng.integers(0, 255, size + (3,)).astype(np.uint8)
             Image.fromarray(arr).save(os.path.join(d, f"{i}.png"))
+
+
+def test_image_loader_uint8_defers_normalization(tmp_path):
+    """out_dtype='uint8' ships raw pixels (4x smaller host→device
+    transfer); normalization belongs on-device (bench.py input-fed)."""
+    from analytics_zoo_tpu.data.image_loader import ImageLoader
+    _write_image_folder(str(tmp_path), n_per_class=4)
+    loader = ImageLoader.from_folder(str(tmp_path), batch_size=4,
+                                     size=(10, 10), out_dtype="uint8")
+    x, y = next(iter(loader))
+    assert x.dtype == np.uint8
+    assert x.shape == (4, 10, 10, 3)
+    assert x.max() > 1  # raw pixel range, not normalized
+    f32 = ImageLoader.from_folder(str(tmp_path), batch_size=4,
+                                  size=(10, 10), scale=1 / 255.0)
+    x2, _ = next(iter(f32))
+    np.testing.assert_allclose(x.astype(np.float32) / 255.0, x2,
+                               atol=1e-6)
+    with pytest.raises(ValueError):
+        ImageLoader([], out_dtype="float16")
 
 
 def test_fit_streams_from_image_folder(tmp_path):
