@@ -1,0 +1,152 @@
+"""``zoo-tpu-submit`` — the launcher entry point.
+
+Parity surface: the reference ships shell launchers that prepare the
+environment and submit the user's program to the cluster
+(reference: scripts/spark-submit-with-zoo.sh:15-41, jupyter-with-zoo.sh).
+The TPU-native analog prepares the ``jax.distributed`` env contract
+(ZOO_TPU_COORDINATOR / NUM_PROCESSES / PROCESS_ID, consumed by
+``init_nncontext`` → parallel/distributed.py) and runs the user script.
+
+Three modes:
+
+* single process (default)            — just run the script;
+* pod process  (--process-id given)   — export the cluster env for THIS
+  process of a multi-host pod, then run the script (invoke once per host,
+  e.g. from your pod manifest);
+* local fan-out (--num-processes N, no --process-id) — spawn N local
+  worker processes forming a real jax.distributed cluster on this
+  machine (CPU by default, ``--devices-per-process`` virtual devices
+  each) — the reference's ``local[n]`` testing story at process
+  granularity.
+
+Examples:
+  zoo-tpu-submit train.py --epochs 10
+  zoo-tpu-submit --num-processes 2 --devices-per-process 4 train.py
+  zoo-tpu-submit --coordinator host0:9876 --num-processes 16 \\
+      --process-id 3 train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import re
+import runpy
+import socket
+import subprocess
+import sys
+from typing import List, Optional
+
+from .parallel.distributed import ENV_COORD, ENV_NPROC, ENV_PID
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_script(script: str, script_args: List[str]):
+    sys.argv = [script] + list(script_args)
+    runpy.run_path(script, run_name="__main__")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="zoo-tpu-submit",
+        description="Run a training/inference script on TPU — single "
+                    "process, one process of a pod, or a local "
+                    "multi-process cluster.")
+    parser.add_argument("--coordinator", default=None,
+                        help="host:port of process 0 (pod mode)")
+    parser.add_argument("--num-processes", type=int, default=1)
+    parser.add_argument("--process-id", type=int, default=None,
+                        help="this process's rank in the pod; omit with "
+                             "--num-processes>1 to fan out locally")
+    parser.add_argument("--devices-per-process", type=int, default=4,
+                        help="virtual CPU devices per local worker "
+                             "(local fan-out mode)")
+    parser.add_argument("--platform", default=None,
+                        help="force JAX_PLATFORMS (e.g. cpu)")
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+        try:  # an accelerator plugin can pre-empt the env var alone
+            import jax
+            jax.config.update("jax_platforms", args.platform)
+        except Exception as e:
+            logging.getLogger("analytics_zoo_tpu").warning(
+                "could not force jax platform %r (%s) — an installed "
+                "accelerator plugin may override it", args.platform, e)
+
+    if args.num_processes <= 1:
+        if args.process_id is not None or args.coordinator:
+            parser.error("--process-id/--coordinator require "
+                         "--num-processes > 1 (pod mode)")
+        _run_script(args.script, args.script_args)
+        return 0
+
+    if args.process_id is not None:
+        # one process of a real pod: export the env contract and run
+        if not args.coordinator:
+            parser.error("--coordinator is required with --process-id")
+        os.environ[ENV_COORD] = args.coordinator
+        os.environ[ENV_NPROC] = str(args.num_processes)
+        os.environ[ENV_PID] = str(args.process_id)
+        _run_script(args.script, args.script_args)
+        return 0
+
+    # local fan-out: a real jax.distributed cluster on this machine.
+    # The probed port can in principle be taken before worker 0 rebinds
+    # it (collision surfaces as a startup error) — pass --coordinator
+    # explicitly to pin a reserved port.
+    coordinator = args.coordinator or f"localhost:{_free_port()}"
+    procs = []
+    for pid in range(args.num_processes):
+        env = dict(os.environ)
+        env[ENV_COORD] = coordinator
+        env[ENV_NPROC] = str(args.num_processes)
+        env[ENV_PID] = str(pid)
+        # local fan-out defaults to CPU workers — an inherited TPU
+        # platform (e.g. a tunnel plugin) must not leak into the
+        # simulated pod
+        env["JAX_PLATFORMS"] = args.platform or "cpu"
+        # --devices-per-process owns the worker topology: replace any
+        # inherited host-platform device count rather than deferring to it
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            f"{args.devices_per_process}").strip()
+        procs.append(subprocess.Popen(
+            [sys.executable, args.script] + list(args.script_args),
+            env=env))
+    rc = 0
+    try:
+        for p in procs:
+            rc = p.wait() or rc
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        # give workers a grace window (mid-write checkpoint shards)
+        # before the finally block hard-kills survivors
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        rc = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
