@@ -1,0 +1,70 @@
+"""TFNet app: image-classification inference from a user's TF graph.
+
+Reference analog: apps/tfnet/image_classification_inference.ipynb —
+load a frozen TF image-classification graph with TFNet and run
+distributed inference over an ImageSet.  Here the "pretrained" graph is
+a small TF CNN built in-process (no model download in this
+environment), frozen via TFNet.from_session, and driven through the
+same preprocess→forward→top-k flow.
+"""
+
+import argparse
+
+import numpy as np
+
+
+def build_tf_graph():
+    import tensorflow.compat.v1 as tf
+    tf.disable_eager_execution()
+    graph = tf.Graph()
+    with graph.as_default():
+        x = tf.placeholder(tf.float32, [None, 32, 32, 3], name="input")
+        k = tf.get_variable("k", [3, 3, 3, 8])
+        b = tf.get_variable("b", [8])
+        h = tf.nn.relu(tf.nn.bias_add(
+            tf.nn.conv2d(x, k, strides=[1, 1, 1, 1], padding="SAME"), b))
+        h = tf.nn.max_pool2d(h, 2, 2, padding="VALID")
+        h = tf.reshape(h, [-1, 16 * 16 * 8])
+        w = tf.get_variable("w", [16 * 16 * 8, 5])
+        logits = tf.nn.bias_add(tf.matmul(h, w),
+                                tf.get_variable("b2", [5]), name="logits")
+        probs = tf.nn.softmax(logits, name="probs")
+        sess = tf.Session(graph=graph)
+        sess.run(tf.global_variables_initializer())
+    return sess, x, probs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=8)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu.pipeline.api.tfgraph.net import TFNet
+    from analytics_zoo_tpu.feature.image import ImageSet
+    from analytics_zoo_tpu.feature.image.transforms import (
+        ImageChannelNormalize, ImageMatToTensor, ImageResize)
+
+    sess, x, probs = build_tf_graph()
+    net = TFNet.from_session(sess, inputs=[x], outputs=[probs])
+
+    rs = np.random.RandomState(0)
+    raw = (rs.rand(args.images, 48, 48, 3) * 255).astype(np.float32)
+    pipeline = (ImageResize(32, 32)
+                >> ImageChannelNormalize(123.0, 117.0, 104.0, 58.0, 57.0,
+                                         57.0)
+                >> ImageMatToTensor())
+    image_set = ImageSet.from_arrays(raw).transform(pipeline)
+    batch = image_set.to_array()
+
+    out = np.asarray(net.predict(batch))
+    top1 = out.argmax(axis=1)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+    for i in range(args.images):
+        print(f"image {i}: class {int(top1[i])} "
+              f"(p={float(out[i, top1[i]]):.3f})")
+    print(f"tfnet inference done: {args.images} images, "
+          f"{out.shape[1]} classes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""Web-service sample: the serving handle behind an HTTP endpoint.
+
+Reference analog: apps/web-service-sample — a Spring web service
+consuming the thread-safe POJO serving API
+(AbstractInferenceModel.java:30-148: a queue of weight-sharing model
+replicas serving concurrent requests).  Here the same role is played by
+``InferenceModel`` (semaphore-bounded concurrency over one jitted
+predict function) behind python's stdlib HTTP server.
+
+POST /predict  {"instances": [[...], ...]}  ->  {"predictions": [...]}
+GET  /health                                ->  {"status": "ok"}
+
+Run standalone:  python web_service.py --port 8900
+(then:  curl -d '{"instances": [[0.1, 0.2, ...]]}' localhost:8900/predict)
+With --self-test the app starts the server, fires concurrent client
+requests against it, verifies the responses, and exits.
+"""
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+def build_model():
+    """A small classifier served by the handle (stand-in for a loaded
+    zoo model; reference services load a pretrained BigDL/TF model)."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    net = Sequential()
+    net.add(Dense(16, activation="relu", input_shape=(8,)))
+    net.add(Dense(3, activation="softmax"))
+    model = InferenceModel(supported_concurrent_num=4)
+    model.load_keras_net(net)
+    return model
+
+
+def make_handler(model):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _reply(self, code, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._reply(200, {"status": "ok"})
+            else:
+                self._reply(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._reply(404, {"error": "unknown path"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                x = np.asarray(payload["instances"], dtype=np.float32)
+                preds = model.predict(x)
+                self._reply(200, {"predictions":
+                                  np.asarray(preds).tolist()})
+            except Exception as e:  # client error surface
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+
+    return Handler
+
+
+def self_test(port: int):
+    from urllib.request import Request, urlopen
+
+    def post(payload):
+        req = Request(f"http://127.0.0.1:{port}/predict",
+                      data=json.dumps(payload).encode(),
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    with urlopen(f"http://127.0.0.1:{port}/health", timeout=30) as r:
+        assert json.loads(r.read())["status"] == "ok"
+
+    # payloads drawn up-front: RandomState is not thread-safe
+    rs = np.random.RandomState(0)
+    payloads = [rs.rand(4, 8).tolist() for _ in range(8)]
+    results = {}
+
+    def client(i):
+        out = post({"instances": payloads[i]})
+        results[i] = np.asarray(out["predictions"])
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 8
+    for preds in results.values():
+        assert preds.shape == (4, 3)
+        np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-4)
+    print("web-service self-test: 8 concurrent clients OK")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args()
+
+    model = build_model()
+    server = ThreadingHTTPServer(("127.0.0.1", args.port),
+                                 make_handler(model))
+    port = server.server_address[1]
+    print(f"serving on http://127.0.0.1:{port} "
+          "(POST /predict, GET /health)", flush=True)
+    if args.self_test:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            self_test(port)
+        finally:
+            server.shutdown()
+    else:
+        server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,27 @@ class TestApps:
         out = run_example("apps/image-similarity/image_similarity.py")
         assert "top-5 purity" in out
 
+    def test_object_detection_app(self, tmp_path):
+        out = run_example("apps/object-detection/object_detection.py",
+                          "--frames", "2", "--out-dir", str(tmp_path))
+        assert "object detection done: 2 frames annotated" in out
+        assert (tmp_path / "frame1.png").exists()
+
+    def test_tfnet_app(self):
+        out = run_example(
+            "apps/tfnet/image_classification_inference.py",
+            "--images", "4")
+        assert "tfnet inference done: 4 images, 5 classes" in out
+
+    def test_web_service_app(self):
+        out = run_example("apps/web-service-sample/web_service.py",
+                          "--self-test")
+        assert "8 concurrent clients OK" in out
+
+    def test_augmentation_3d_app(self):
+        out = run_example("apps/image-augmentation-3d/augmentation_3d.py")
+        assert "3d augmentation done: 3 volumes" in out
+
     def test_transfer_learning_weights_actually_transfer(self):
         # regression for transfer_weights_from: frozen-backbone task B
         # must beat chance by a wide margin
