@@ -43,6 +43,58 @@ class ZooModel(KerasNet):
         m._compile_args = config.get("compile_args")
         return m
 
+def parse_quantize_name(model_name: str):
+    """'<arch>[-quantize]' -> (arch, wants_int8) — the one place the
+    registry's quantize-suffix convention is encoded (reference carries
+    '*-quantize' variants, ObjectDetectionConfig.scala:33-44,
+    ImageClassificationConfig.scala:34-50)."""
+    if model_name.endswith("-quantize"):
+        return model_name[:-len("-quantize")], True
+    return model_name, False
+
+
+class QuantizedVariantMixin:
+    """Shared machinery for zoo models whose registry carries
+    '<name>-quantize' variants: lazy int8 graph on predict, invalidated
+    by EVERY weight-mutating entry point so a quantized handle can never
+    serve stale weights."""
+
+    _quantized_net = None
+
+    def _invalidate_quantized(self):
+        self._quantized_net = None
+
+    def compile(self, *a, **kw):
+        self._invalidate_quantized()
+        return super().compile(*a, **kw)
+
+    def fit(self, *a, **kw):
+        self._invalidate_quantized()
+        return super().fit(*a, **kw)
+
+    def set_weights(self, params):
+        self._invalidate_quantized()
+        return super().set_weights(params)
+
+    def load_weights(self, directory: str, tag=None):
+        self._invalidate_quantized()
+        return super().load_weights(directory, tag)
+
+    def transfer_weights_from(self, other):
+        self._invalidate_quantized()
+        return super().transfer_weights_from(other)
+
+    def predict(self, x, batch_size: int = 32, distributed: bool = True):
+        """'-quantize' variants run int8 inference; the int8 graph is
+        built lazily from the current weights."""
+        _, wants_int8 = parse_quantize_name(self.hyper["model_name"])
+        if wants_int8:
+            if self._quantized_net is None:
+                self._quantized_net = self.quantize()
+            return self._quantized_net.predict(x, batch_size)
+        return super().predict(x, batch_size, distributed)
+
+
 def register_zoo_model(cls):
     """Make the model loadable via KerasNet.load_model."""
     _MODEL_CLASSES[cls.__name__] = cls

@@ -22,7 +22,8 @@ from ...pipeline.api.keras.layers import (
     Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
     Dropout, Flatten, GlobalAveragePooling2D, MaxPooling2D, Merge,
     SeparableConvolution2D, ZeroPadding2D)
-from ..common import ZooModel, register_zoo_model
+from ..common import (QuantizedVariantMixin, ZooModel, parse_quantize_name,
+                      register_zoo_model)
 
 
 def _conv_bn(x, filters, kernel, stride=1, padding="same", activation="relu",
@@ -281,8 +282,8 @@ def densenet161(input_shape=(224, 224, 3), num_classes=1000):
 # ---------------------------------------------------------------- registry
 
 def _parse_model_name(model_name: str):
-    """'<arch>[-quantize]' -> (arch, wants_int8) — the one place the
-    registry's quantize-suffix convention is encoded."""
+    """'<arch>[-quantize]' -> (arch, wants_int8).  Canonical home:
+    models.common.parse_quantize_name (kept as an alias here)."""
     if model_name.endswith("-quantize"):
         return model_name[:-len("-quantize")], True
     return model_name, False
@@ -301,7 +302,7 @@ _ARCHITECTURES: Dict[str, Callable] = {
 
 
 @register_zoo_model
-class ImageClassifier(ZooModel):
+class ImageClassifier(QuantizedVariantMixin, ZooModel):
     """Named-architecture image classifier
     (reference ImageClassifier.scala + config registry)."""
 
@@ -309,7 +310,7 @@ class ImageClassifier(ZooModel):
                  num_classes=1000, name=None, **kw):
         # reference registry carries '<arch>-quantize' variants
         # (ImageClassificationConfig.scala:34-50): same architecture, int8
-        # inference path
+        # inference path (dispatch + cache in QuantizedVariantMixin)
         base, _ = _parse_model_name(model_name)
         if base not in _ARCHITECTURES:
             raise ValueError(
@@ -318,42 +319,12 @@ class ImageClassifier(ZooModel):
         super().__init__(name=name, model_name=model_name,
                          input_shape=tuple(input_shape),
                          num_classes=num_classes, **kw)
-        self._quantized_net = None
 
     def build_model(self) -> Model:
         h = self.hyper
         base, _ = _parse_model_name(h["model_name"])
         return _ARCHITECTURES[base](
             input_shape=h["input_shape"], num_classes=h["num_classes"])
-
-    # any weight mutation must invalidate the cached int8 graph, or
-    # quantized predict would keep serving the old weights
-    def compile(self, *a, **kw):
-        self._quantized_net = None
-        return super().compile(*a, **kw)
-
-    def set_weights(self, params):
-        self._quantized_net = None
-        return super().set_weights(params)
-
-    def load_weights(self, directory: str, tag=None):
-        self._quantized_net = None
-        return super().load_weights(directory, tag)
-
-    def fit(self, *a, **kw):
-        self._quantized_net = None
-        return super().fit(*a, **kw)
-
-    def predict(self, x, batch_size: int = 32, distributed: bool = True):
-        """'-quantize' variants run int8 inference; the int8 graph is
-        built lazily from the current weights and invalidated whenever
-        they change (compile/fit/set_weights/load_weights)."""
-        _, wants_int8 = _parse_model_name(self.hyper["model_name"])
-        if wants_int8:
-            if self._quantized_net is None:
-                self._quantized_net = self.quantize()
-            return self._quantized_net.predict(x, batch_size)
-        return super().predict(x, batch_size, distributed)
 
     def predict_image_set(self, image_set, configure=None):
         """predictImageSet parity (ImageModel.scala:45-69): preprocess →

@@ -34,7 +34,8 @@ from ...pipeline.api.keras.engine import Model
 from ...pipeline.api.keras.layers import (
     Activation, BatchNormalization, Convolution2D, Dense,
     GlobalAveragePooling2D, MaxPooling2D, Merge, Reshape, ZeroPadding2D)
-from ..common import ZooModel, register_zoo_model
+from ..common import (QuantizedVariantMixin, ZooModel, parse_quantize_name,
+                      register_zoo_model)
 
 
 # ------------------------------------------------------------ prior boxes
@@ -336,18 +337,23 @@ _DETECTORS = {
 
 
 @register_zoo_model
-class ObjectDetector(ZooModel):
+class ObjectDetector(QuantizedVariantMixin, ZooModel):
     """Named SSD detector with jit postprocessing
     (reference ObjectDetector.scala + ObjectDetectionConfig registry)."""
 
     def __init__(self, model_name="ssd-vgg16-300", num_classes=21,
                  conf_threshold=0.01, nms_threshold=0.45,
                  max_detections=100, name=None, **kw):
-        if model_name not in _DETECTORS:
+        # '<name>-quantize' = same architecture, int8 inference path
+        # (reference registry ObjectDetectionConfig.scala:33-44 carries
+        # ssd-vgg16-300-quantize etc.; dispatch + cache in
+        # QuantizedVariantMixin)
+        base, _ = parse_quantize_name(model_name)
+        if base not in _DETECTORS:
             raise ValueError(
                 f"Unknown detector {model_name!r}; known: "
-                f"{sorted(_DETECTORS)} (frcnn variants are out of scope "
-                "in the TPU build)")
+                f"{sorted(_DETECTORS)} (+ '-quantize' suffixes; frcnn "
+                "variants are out of scope in the TPU build)")
         super().__init__(name=name, model_name=model_name,
                          num_classes=num_classes,
                          conf_threshold=conf_threshold,
@@ -359,8 +365,8 @@ class ObjectDetector(ZooModel):
 
     def build_model(self) -> Model:
         h = self.hyper
-        model, self._image_size = _DETECTORS[h["model_name"]](
-            h["num_classes"])
+        base, _ = parse_quantize_name(h["model_name"])
+        model, self._image_size = _DETECTORS[base](h["num_classes"])
         return model
 
     def predict_image_set(self, image_set, batch_size: int = 8):

@@ -159,17 +159,90 @@ class QuantizedConv(_QuantizedLayer):
         return src._from_cl(y)
 
 
+@register_layer
+class QuantizedEmbedding(_QuantizedLayer):
+    """int8 inference version of Embedding: the lookup table is stored
+    int8 with a per-ROW scale (each token's vector has its own absmax
+    window), dequantized after the gather — a 4x smaller table, and the
+    gather itself moves 4x fewer bytes."""
+
+    @classmethod
+    def from_layer(cls, emb, params: Params) -> "QuantizedEmbedding":
+        # rows are the output axis of a lookup: per-row scales
+        tq, scale = quantize_per_channel(params["embeddings"], out_axis=0)
+        return cls(emb, {"Eq": tq, "e_scale": scale})
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        idx = inputs.astype(jnp.int32)
+        vecs = jnp.take(params["Eq"], idx, axis=0).astype(jnp.float32)
+        scales = jnp.take(params["e_scale"], idx, axis=0)
+        return vecs * scales[..., None]
+
+
+@register_layer
+class QuantizedSeparableConv(_QuantizedLayer):
+    """int8 inference version of SeparableConvolution2D.
+
+    The PLAIN 1x1 pointwise conv — where virtually all the FLOPs and
+    weights live — runs int8; the depthwise conv stays float (its weight
+    is tiny and grouped convs don't hit the MXU's int8 path cleanly)."""
+
+    @classmethod
+    def from_layer(cls, sep, params: Params) -> "QuantizedSeparableConv":
+        wq, scale = quantize_per_channel(params["pointwise"], out_axis=-1)
+        initial = {"depthwise": jnp.asarray(params["depthwise"],
+                                            jnp.float32),
+                   "Pq": wq, "p_scale": scale}
+        if sep.bias:
+            initial["b"] = jnp.asarray(params["b"], jnp.float32)
+        return cls(sep, initial)
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        from ..pipeline.api.keras.layers.convolutional import _DN
+        src = self.src
+        x = inputs
+        if src.data_format == "channels_first":
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        in_ch = x.shape[-1]
+        pad = "SAME" if src.border_mode == "same" else "VALID"
+        y = lax.conv_general_dilated(
+            x, params["depthwise"], window_strides=src.subsample,
+            padding=pad, dimension_numbers=_DN[2],
+            feature_group_count=in_ch)
+        y = int8_conv(y, params["Pq"], params["p_scale"], strides=(1, 1),
+                      padding="VALID", rhs_dilation=None,
+                      dimension_numbers=_DN[2])
+        if src.bias:
+            y = y + params["b"]
+        if src.activation is not None:
+            y = src.activation(y)
+        if src.data_format == "channels_first":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+
 # ---------------------------------------------------------------------------
 # graph transformation
 
 def _quantizable(layer: Layer, params: Params) -> Optional[type]:
     """Return the quantized wrapper class for supported layers.
 
-    Supported: Dense and plain _ConvND convolutions *that did not override
-    the compute path* (subclasses with custom call/_conv — e.g. separable
-    or transposed variants — are left in float)."""
-    from ..pipeline.api.keras.layers.convolutional import _ConvND
+    Supported: Dense, plain _ConvND convolutions, Embedding lookups, and
+    SeparableConvolution2D (pointwise part) — each only when the subclass
+    *did not override the compute path* (custom call/_conv variants are
+    left in float)."""
+    from ..pipeline.api.keras.layers.convolutional import (
+        _ConvND, SeparableConvolution2D)
     from ..pipeline.api.keras.layers.core import Dense
+    from ..pipeline.api.keras.layers.embedding import Embedding
+    if isinstance(layer, Embedding) \
+            and type(layer).call is Embedding.call \
+            and "embeddings" in params:
+        return QuantizedEmbedding
+    if isinstance(layer, SeparableConvolution2D) \
+            and type(layer).call is SeparableConvolution2D.call \
+            and "pointwise" in params:
+        return QuantizedSeparableConv
     if "W" not in params or not jnp.issubdtype(
             jnp.asarray(params["W"]).dtype, jnp.floating):
         return None
