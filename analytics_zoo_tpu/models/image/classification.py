@@ -234,6 +234,87 @@ def inception_v1(input_shape=(224, 224, 3), num_classes=1000):
     return Model(input=inp, output=x, name="inception_v1")
 
 
+def _conv_bn_v3(x, filters, nr, nc, strides=(1, 1), padding="same",
+                name=None):
+    """conv + BN(scale-free in tf.keras; our gamma stays 1 on weight
+    import) + relu — the conv2d_bn unit of keras.applications
+    inception_v3, which inception_v3 below mirrors block-for-block so
+    tf.keras InceptionV3 checkpoints transfer by op order
+    (models/weight_loading.py)."""
+    x = Convolution2D(filters, nr, nc, subsample=strides,
+                      border_mode=padding, bias=False, name=name)(x)
+    x = BatchNormalization()(x)
+    return Activation("relu")(x)
+
+
+def inception_v3(input_shape=(299, 299, 3), num_classes=1000,
+                 include_top=True):
+    """Inception-v3 (the reference registry's 'inception-v3',
+    ImageClassificationConfig.scala:34-50).  With ``include_top=False``
+    the output is the 2048-d global-average-pooled feature (matching
+    tf.keras ``include_top=False, pooling='avg'`` for oracle testing and
+    transfer learning)."""
+    cb = _conv_bn_v3
+    inp = Input(input_shape, name="image")
+    x = cb(inp, 32, 3, 3, strides=(2, 2), padding="valid")
+    x = cb(x, 32, 3, 3, padding="valid")
+    x = cb(x, 64, 3, 3)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = cb(x, 80, 1, 1, padding="valid")
+    x = cb(x, 192, 3, 3, padding="valid")
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+
+    cat = lambda parts: Merge(mode="concat", concat_axis=-1)(parts)
+    # mixed 0-2
+    for pool_ch in (32, 64, 64):
+        b1 = cb(x, 64, 1, 1)
+        b5 = cb(cb(x, 48, 1, 1), 64, 5, 5)
+        b3 = cb(cb(cb(x, 64, 1, 1), 96, 3, 3), 96, 3, 3)
+        bp = AveragePooling2D(pool_size=(3, 3), strides=(1, 1),
+                              border_mode="same")(x)
+        bp = cb(bp, pool_ch, 1, 1)
+        x = cat([b1, b5, b3, bp])
+    # mixed 3
+    b3 = cb(x, 384, 3, 3, strides=(2, 2), padding="valid")
+    bd = cb(cb(x, 64, 1, 1), 96, 3, 3)
+    bd = cb(bd, 96, 3, 3, strides=(2, 2), padding="valid")
+    bp = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = cat([b3, bd, bp])
+    # mixed 4-7
+    for mid in (128, 160, 160, 192):
+        b1 = cb(x, 192, 1, 1)
+        b7 = cb(cb(cb(x, mid, 1, 1), mid, 1, 7), 192, 7, 1)
+        bd = cb(x, mid, 1, 1)
+        bd = cb(cb(bd, mid, 7, 1), mid, 1, 7)
+        bd = cb(cb(bd, mid, 7, 1), 192, 1, 7)
+        bp = AveragePooling2D(pool_size=(3, 3), strides=(1, 1),
+                              border_mode="same")(x)
+        bp = cb(bp, 192, 1, 1)
+        x = cat([b1, b7, bd, bp])
+    # mixed 8
+    b3 = cb(cb(x, 192, 1, 1), 320, 3, 3, strides=(2, 2), padding="valid")
+    b7 = cb(cb(cb(x, 192, 1, 1), 192, 1, 7), 192, 7, 1)
+    b7 = cb(b7, 192, 3, 3, strides=(2, 2), padding="valid")
+    bp = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = cat([b3, b7, bp])
+    # mixed 9-10
+    for _ in range(2):
+        b1 = cb(x, 320, 1, 1)
+        b3 = cb(x, 384, 1, 1)
+        b3 = cat([cb(b3, 384, 1, 3), cb(b3, 384, 3, 1)])
+        bd = cb(cb(x, 448, 1, 1), 384, 3, 3)
+        bd = cat([cb(bd, 384, 1, 3), cb(bd, 384, 3, 1)])
+        bp = AveragePooling2D(pool_size=(3, 3), strides=(1, 1),
+                              border_mode="same")(x)
+        bp = cb(bp, 192, 1, 1)
+        x = cat([b1, b3, bd, bp])
+    x = GlobalAveragePooling2D()(x)
+    if include_top:
+        x = Dense(num_classes, activation="softmax",
+                  name="predictions")(x)
+    return Model(input=inp, output=x, name="inception_v3")
+
+
 # ---------------------------------------------------------------- DenseNet
 
 def _dense_block(x, layers, growth, prefix):
@@ -281,12 +362,9 @@ def densenet161(input_shape=(224, 224, 3), num_classes=1000):
 
 # ---------------------------------------------------------------- registry
 
-def _parse_model_name(model_name: str):
-    """'<arch>[-quantize]' -> (arch, wants_int8).  Canonical home:
-    models.common.parse_quantize_name (kept as an alias here)."""
-    if model_name.endswith("-quantize"):
-        return model_name[:-len("-quantize")], True
-    return model_name, False
+# '<arch>[-quantize]' -> (arch, wants_int8); canonical implementation
+# lives in models.common so every registry parses the suffix identically
+_parse_model_name = parse_quantize_name
 
 
 _ARCHITECTURES: Dict[str, Callable] = {
@@ -297,6 +375,7 @@ _ARCHITECTURES: Dict[str, Callable] = {
     "mobilenet-v2": mobilenet_v2,
     "squeezenet": squeezenet,
     "inception-v1": inception_v1,
+    "inception-v3": inception_v3,
     "densenet-161": densenet161,
 }
 

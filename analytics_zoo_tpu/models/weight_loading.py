@@ -1,0 +1,209 @@
+"""Pretrained-weight loading: layout converters from public checkpoint
+formats into registry models.
+
+Parity surface: the reference's model zoo serves *pretrained* models
+(ImageClassificationConfig.scala:34-50 downloads published weights); its
+test suites encode the layout traps with per-layer ``weightConverter``
+functions (reference DenseSpec.scala:29).  Here the same role is played
+by two whole-model converters:
+
+* ``load_tf_keras_weights`` — from a live ``tf.keras`` model (or its
+  ``get_weights`` layer list).  tf.keras convs are already HWIO (our
+  layout); the work is pairing by op order, splitting BN gamma/beta
+  (params) from moving stats (state), and handling scale-free BNs.
+* ``load_torch_state_dict`` — from a PyTorch ``state_dict``.  Torch
+  convs are OIHW and linears are (out, in): both transpose.
+
+Both match OUR graph's layer order against the source's layer order per
+kind (conv/bn/dense) — which is construction order on both sides — so a
+registry model written to mirror its public counterpart block-for-block
+(e.g. ``inception_v3``) loads that counterpart's checkpoints directly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+import jax
+
+
+def _name_counter(name: str) -> int:
+    """Trailing auto-name counter ('conv2d_9' -> 9, 'conv2d' -> -1) —
+    creation order within a kind on BOTH sides (graph traversals order
+    branchy models differently from code order, so topological order
+    cannot pair them; creation counters can)."""
+    tail = name.rpartition("_")[2]
+    return int(tail) if tail.isdigit() else -1
+
+
+def _our_layers_by_kind(net) -> Dict[str, List[object]]:
+    """kind -> weight-bearing layers of ``net``'s graph in CREATION
+    order; kind in {conv, bn, dense}."""
+    from ..pipeline.api.keras.layers.convolutional import _ConvND
+    from ..pipeline.api.keras.layers.core import Dense
+    from ..pipeline.api.keras.layers.normalization import (
+        BatchNormalization)
+
+    graph = net.to_graph()
+    seen = set()
+    out: Dict[str, List[object]] = {"conv": [], "bn": [], "dense": []}
+    for v in graph.nodes:
+        layer = v.layer
+        if layer is None or id(layer) in seen:
+            continue
+        seen.add(id(layer))
+        if isinstance(layer, _ConvND):
+            out["conv"].append(layer)
+        elif isinstance(layer, BatchNormalization):
+            out["bn"].append(layer)
+        elif isinstance(layer, Dense):
+            out["dense"].append(layer)
+    for kind in out:
+        out[kind].sort(key=lambda l: _name_counter(l.name))
+    return out
+
+
+def _pair_by_kind(ours: Dict[str, List], theirs: Dict[str, List],
+                  source: str):
+    """Zip per-kind creation-order sequences; count mismatches raise."""
+    n_ours = sum(len(v) for v in ours.values())
+    n_theirs = sum(len(v) for v in theirs.values())
+    if n_ours != n_theirs or any(
+            len(ours[k]) != len(theirs.get(k, [])) for k in ours):
+        detail = {k: (len(ours[k]), len(theirs.get(k, []))) for k in ours}
+        raise ValueError(
+            f"op-count mismatch: ours vs {source} per kind "
+            f"(ours, theirs) = {detail}")
+    for kind in ("conv", "bn", "dense"):
+        for ol, tl in zip(ours[kind], theirs.get(kind, [])):
+            yield kind, ol, tl
+
+
+def _apply(net, params: Dict, state: Dict):
+    """Merge converted entries into the net's current weights/state."""
+    trainer = net.ensure_inference_ready()
+    new_params = dict(jax.device_get(trainer.state.params))
+    for k, v in params.items():
+        cur = new_params.get(k, {})
+        merged = dict(cur)
+        merged.update(v)
+        new_params[k] = merged
+    net.set_weights(new_params)
+    if state:
+        new_state = dict(jax.device_get(trainer.state.model_state))
+        for k, v in state.items():
+            cur = dict(new_state.get(k, {}))
+            cur.update(v)
+            new_state[k] = cur
+        # place under the trainer's replicated mesh sharding — a bare
+        # device_put would commit the stats to one device and conflict
+        # with mesh-sharded params inside jit
+        trainer.state.model_state = jax.device_put(
+            new_state, trainer._repl_sharding)
+    return net
+
+
+def load_tf_keras_weights(net, keras_model) -> object:
+    """Transfer a tf.keras model's weights into ``net`` by op order.
+
+    Supports Conv2D (with/without bias), BatchNormalization (with/without
+    scale/center), and Dense.  Raises when the op sequences disagree in
+    kind or shape — a structural mismatch, not a silent skip."""
+    ours = _our_layers_by_kind(net)
+    kind_of = {"Conv2D": "conv", "BatchNormalization": "bn",
+               "Dense": "dense"}
+    theirs: Dict[str, List[object]] = {"conv": [], "bn": [], "dense": []}
+    for kl in keras_model.layers:
+        kind = kind_of.get(type(kl).__name__)
+        if kind:
+            theirs[kind].append(kl)
+    for kind in theirs:
+        theirs[kind].sort(key=lambda l: _name_counter(l.name))
+    params: Dict = {}
+    state: Dict = {}
+    for ok, ol, tl in _pair_by_kind(ours, theirs, "keras model"):
+        w = [np.asarray(a) for a in tl.get_weights()]
+        if ok == "conv":
+            entry = {"W": w[0]}  # HWIO on both sides
+            if getattr(ol, "bias", False):
+                # source without a bias: zero ours — forward-equivalent
+                # to the bias-free source (never keep random init)
+                entry["b"] = (w[1] if len(w) > 1
+                              else np.zeros((w[0].shape[-1],), np.float32))
+            params[ol.name] = entry
+        elif ok == "dense":
+            entry = {"W": w[0]}
+            if getattr(ol, "bias", True):
+                entry["b"] = (w[1] if len(w) > 1
+                              else np.zeros((w[0].shape[-1],), np.float32))
+            params[ol.name] = entry
+        else:  # bn — keras order: [gamma][beta] mean var
+            i = 0
+            n = w[-1].shape[0]
+            if getattr(tl, "scale", True):
+                gamma = w[i]
+                i += 1
+            else:
+                gamma = np.ones((n,), np.float32)
+            if getattr(tl, "center", True):
+                beta = w[i]
+                i += 1
+            else:
+                beta = np.zeros((n,), np.float32)
+            mean, var = w[i], w[i + 1]
+            params[ol.name] = {"gamma": gamma, "beta": beta}
+            state[ol.name] = {"moving_mean": mean, "moving_var": var}
+    return _apply(net, params, state)
+
+
+def load_torch_state_dict(net, state_dict) -> object:
+    """Transfer a PyTorch ``state_dict`` into ``net`` by op order.
+
+    Layout conversion (the reference's weightConverter traps):
+    conv OIHW → HWIO (transpose 2,3,1,0); linear (out,in) → (in,out).
+    BN weight/bias → gamma/beta, running stats → moving stats."""
+    ours = _our_layers_by_kind(net)
+    # group torch entries by module prefix, preserving insertion order
+    # (state_dict insertion order IS construction order in torch)
+    groups: Dict[str, Dict[str, np.ndarray]] = {}
+    for key, val in state_dict.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        prefix, _, leaf = key.rpartition(".")
+        groups.setdefault(prefix, {})[leaf] = np.asarray(
+            val.detach().cpu().numpy() if hasattr(val, "detach") else val)
+    theirs: Dict[str, List] = {"conv": [], "bn": [], "dense": []}
+    for prefix, g in groups.items():
+        if "running_mean" in g:
+            theirs["bn"].append(g)
+        elif "weight" in g and g["weight"].ndim == 4:
+            theirs["conv"].append(g)
+        elif "weight" in g and g["weight"].ndim == 2:
+            theirs["dense"].append(g)
+    params: Dict = {}
+    state: Dict = {}
+    for ok, ol, g in _pair_by_kind(ours, theirs, "state_dict"):
+        if ok == "conv":
+            w = g["weight"].transpose(2, 3, 1, 0)  # OIHW→HWIO
+            entry = {"W": w}
+            if getattr(ol, "bias", False):
+                # bias-free torch conv: zero ours (forward-equivalent)
+                entry["b"] = g.get("bias",
+                                   np.zeros((w.shape[-1],), np.float32))
+            params[ol.name] = entry
+        elif ok == "dense":
+            w = g["weight"].T  # (out,in) → (in,out)
+            entry = {"W": w}
+            if getattr(ol, "bias", True):
+                entry["b"] = g.get("bias",
+                                   np.zeros((w.shape[-1],), np.float32))
+            params[ol.name] = entry
+        else:
+            n = g["running_mean"].shape[0]
+            params[ol.name] = {
+                "gamma": g.get("weight", np.ones((n,), np.float32)),
+                "beta": g.get("bias", np.zeros((n,), np.float32))}
+            state[ol.name] = {"moving_mean": g["running_mean"],
+                              "moving_var": g["running_var"]}
+    return _apply(net, params, state)

@@ -108,10 +108,16 @@ class KerasNet(Layer):
         self.trainer.ensure_initialized()
         return self.trainer
 
-    def set_tensorboard(self, log_dir: str, app_name: str):
-        self._tensorboard = (log_dir, app_name)
+    def set_tensorboard(self, log_dir: str, app_name: str,
+                        profile: bool = False, profile_steps: int = 10):
+        """``profile=True`` additionally captures one jax.profiler trace
+        per fit so TensorBoard shows step timelines (SURVEY §5 tracing
+        parity)."""
+        self._tensorboard = (log_dir, app_name, profile, profile_steps)
         if self.trainer is not None:
-            self.trainer.set_tensorboard(log_dir, app_name)
+            self.trainer.set_tensorboard(log_dir, app_name,
+                                         profile=profile,
+                                         profile_steps=profile_steps)
 
     def set_checkpoint(self, path: str, over_write: bool = True):
         self._checkpoint = (path, over_write)

@@ -19,6 +19,7 @@ Semantics preserved from the reference:
 
 from __future__ import annotations
 
+import os
 import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -279,10 +280,24 @@ class Trainer:
               else put(y))
         return xs, ys
 
-    def set_tensorboard(self, log_dir: str, app_name: str):
-        """Parity: KerasNet.setTensorBoard (Topology.scala:157-175)."""
+    def set_tensorboard(self, log_dir: str, app_name: str,
+                        profile: bool = False, profile_steps: int = 10):
+        """Parity: KerasNet.setTensorBoard (Topology.scala:157-175).
+
+        ``profile=True`` additionally captures ONE ``jax.profiler`` trace
+        per fit (the first ``profile_steps`` steps) under
+        ``<log_dir>/<app_name>/plugins/profile`` so TensorBoard shows the
+        step timeline alongside the scalars — the reference's ``timing()``
+        wall-clock wrappers, upgraded to a real device trace
+        (InferenceSupportive.scala:37-44; SURVEY §5)."""
         self.train_summary = TrainSummary(log_dir, app_name)
         self.val_summary = ValidationSummary(log_dir, app_name)
+        self._profile_dir = (os.path.join(log_dir, app_name)
+                             if profile else None)
+        self._profile_steps = int(profile_steps)
+
+    _profile_dir: Optional[str] = None
+    _profile_steps: int = 10
 
     def set_checkpoint(self, path: str, over_write: bool = True,
                        trigger=None):
@@ -323,88 +338,118 @@ class Trainer:
 
         lr_fn = getattr(self.optimizer, "lr_fn", None)
         stop = False
+        # one profiler trace per fit (default off): first N steps
+        profiling = False
+        profile_end_step = None
+        if self._profile_dir is not None:
+            try:
+                jax.profiler.start_trace(self._profile_dir)
+                profiling = True
+                profile_end_step = st.step + self._profile_steps
+            except Exception as e:  # tracing is best-effort telemetry
+                import logging
+                logging.getLogger("analytics_zoo_tpu").warning(
+                    "could not start jax.profiler trace: %s", e)
 
-        while True:
-            record = {"epoch": st.epoch, "iteration": st.step}
-            if stop or end_trigger(record):
-                break
-            epoch_start, epoch_samples = time.time(), 0
-            # per-epoch device-side loss buffer: NO per-step host sync —
-            # losses stay on device and are fetched in one bulk transfer at
-            # the epoch boundary (the round-1 `float(loss)` per step
-            # destroyed async dispatch).  Loss-dependent triggers (MinLoss)
-            # still work: the record carries the device scalar and only
-            # such a trigger pays the sync.
-            epoch_losses = []
-            batch_it = dataset.batches(per_host_bs, shuffle=shuffle,
-                                       seed=self.seed, epoch=st.epoch)
-            for bx, by in prefetch_iterator(
-                    batch_it, lambda b: self._put_batch(*b)):
-                step_rng = jax.random.fold_in(st.rng, st.step)
-                st.params, st.model_state, st.opt_state, loss = \
-                    self._train_step(st.params, st.model_state,
-                                     st.opt_state, step_rng, bx, by)
-                st.step += 1
-                epoch_samples += batch_size
-                epoch_losses.append(loss)
-                it_record = {"epoch": st.epoch, "iteration": st.step,
-                             "loss": loss}
-                if self._ckpt_path and not isinstance(
-                        self._ckpt_trigger, trigger_lib.EveryEpoch) \
-                        and self._ckpt_trigger(it_record):
-                    async_save_sharded(
-                        self._ckpt_path, st.step, st.as_tree(),
-                        meta={"step": st.step, "epoch": st.epoch})
-                if end_trigger(it_record):
-                    # remember the firing so the outer loop terminates even
-                    # for triggers the outer record can't re-evaluate
-                    # (e.g. MinLoss — the per-epoch record carries no loss)
-                    stop = True
+        def _stop_profile():
+            nonlocal profiling
+            if profiling:
+                profiling = False
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+
+        try:
+            while True:
+                record = {"epoch": st.epoch, "iteration": st.step}
+                if stop or end_trigger(record):
                     break
-            st.epoch += 1
-            # one bulk host transfer for the whole epoch's scalars
-            losses_host = ([float(v) for v in
-                            np.asarray(jax.device_get(epoch_losses))]
-                           if epoch_losses else [])
-            base_step = st.step - len(losses_host)
-            history["loss"].extend(losses_host)
-            elapsed = max(time.time() - epoch_start, 1e-9)
-            if self.train_summary is not None:
-                for i, lossf in enumerate(losses_host):
-                    step_i = base_step + i + 1
-                    self.train_summary.add_scalar("Loss", lossf, step_i)
-                    if lr_fn is not None:
-                        self.train_summary.add_scalar(
-                            "LearningRate", float(lr_fn(step_i - 1)),
-                            step_i)
-                self.train_summary.add_scalar(
-                    "Throughput", epoch_samples / elapsed, st.step)
-                self.train_summary.flush()
-            epoch_record = {"epoch": st.epoch, "iteration": st.step,
-                            "epoch_finished": True,
-                            "loss": history["loss"][-1]
-                            if history["loss"] else None}
-            if verbose:
-                print(f"[zoo-tpu] epoch {st.epoch} step {st.step} "
-                      f"loss {epoch_record['loss']:.4f} "
-                      f"({epoch_samples / elapsed:.0f} samples/s)")
-            if validation_data is not None and validation_trigger(
-                    epoch_record):
-                results = self.evaluate(validation_data,
-                                        validation_batch_size or batch_size)
-                history["val"].append({"epoch": st.epoch, **results})
-                if self.val_summary is not None:
-                    for k, v in results.items():
-                        self.val_summary.add_scalar(k, v, st.step)
-                    self.val_summary.flush()
+                epoch_start, epoch_samples = time.time(), 0
+                # per-epoch device-side loss buffer: NO per-step host sync —
+                # losses stay on device and are fetched in one bulk transfer at
+                # the epoch boundary (the round-1 `float(loss)` per step
+                # destroyed async dispatch).  Loss-dependent triggers (MinLoss)
+                # still work: the record carries the device scalar and only
+                # such a trigger pays the sync.
+                epoch_losses = []
+                batch_it = dataset.batches(per_host_bs, shuffle=shuffle,
+                                           seed=self.seed, epoch=st.epoch)
+                for bx, by in prefetch_iterator(
+                        batch_it, lambda b: self._put_batch(*b)):
+                    step_rng = jax.random.fold_in(st.rng, st.step)
+                    st.params, st.model_state, st.opt_state, loss = \
+                        self._train_step(st.params, st.model_state,
+                                         st.opt_state, step_rng, bx, by)
+                    st.step += 1
+                    epoch_samples += batch_size
+                    epoch_losses.append(loss)
+                    if profiling and st.step >= profile_end_step:
+                        jax.block_until_ready(loss)  # trace covers real work
+                        _stop_profile()
+                    it_record = {"epoch": st.epoch, "iteration": st.step,
+                                 "loss": loss}
+                    if self._ckpt_path and not isinstance(
+                            self._ckpt_trigger, trigger_lib.EveryEpoch) \
+                            and self._ckpt_trigger(it_record):
+                        async_save_sharded(
+                            self._ckpt_path, st.step, st.as_tree(),
+                            meta={"step": st.step, "epoch": st.epoch})
+                    if end_trigger(it_record):
+                        # remember the firing so the outer loop terminates even
+                        # for triggers the outer record can't re-evaluate
+                        # (e.g. MinLoss — the per-epoch record carries no loss)
+                        stop = True
+                        break
+                st.epoch += 1
+                # one bulk host transfer for the whole epoch's scalars
+                losses_host = ([float(v) for v in
+                                np.asarray(jax.device_get(epoch_losses))]
+                               if epoch_losses else [])
+                base_step = st.step - len(losses_host)
+                history["loss"].extend(losses_host)
+                elapsed = max(time.time() - epoch_start, 1e-9)
+                if self.train_summary is not None:
+                    for i, lossf in enumerate(losses_host):
+                        step_i = base_step + i + 1
+                        self.train_summary.add_scalar("Loss", lossf, step_i)
+                        if lr_fn is not None:
+                            self.train_summary.add_scalar(
+                                "LearningRate", float(lr_fn(step_i - 1)),
+                                step_i)
+                    self.train_summary.add_scalar(
+                        "Throughput", epoch_samples / elapsed, st.step)
+                    self.train_summary.flush()
+                epoch_record = {"epoch": st.epoch, "iteration": st.step,
+                                "epoch_finished": True,
+                                "loss": history["loss"][-1]
+                                if history["loss"] else None}
                 if verbose:
-                    print(f"[zoo-tpu]   validation: {results}")
-            if self._ckpt_path and isinstance(self._ckpt_trigger,
-                                              trigger_lib.EveryEpoch):
-                async_save_sharded(self._ckpt_path, f"epoch{st.epoch}",
-                                   st.as_tree(),
-                                   meta={"step": st.step,
-                                         "epoch": st.epoch})
+                    print(f"[zoo-tpu] epoch {st.epoch} step {st.step} "
+                          f"loss {epoch_record['loss']:.4f} "
+                          f"({epoch_samples / elapsed:.0f} samples/s)")
+                if validation_data is not None and validation_trigger(
+                        epoch_record):
+                    results = self.evaluate(validation_data,
+                                            validation_batch_size or batch_size)
+                    history["val"].append({"epoch": st.epoch, **results})
+                    if self.val_summary is not None:
+                        for k, v in results.items():
+                            self.val_summary.add_scalar(k, v, st.step)
+                        self.val_summary.flush()
+                    if verbose:
+                        print(f"[zoo-tpu]   validation: {results}")
+                if self._ckpt_path and isinstance(self._ckpt_trigger,
+                                                  trigger_lib.EveryEpoch):
+                    async_save_sharded(self._ckpt_path, f"epoch{st.epoch}",
+                                       st.as_tree(),
+                                       meta={"step": st.step,
+                                             "epoch": st.epoch})
+        finally:
+            # the trace must stop even when fit raises mid-epoch, or
+            # profiling stays broken for the process ('trace already
+            # started')
+            _stop_profile()
         if self._ckpt_path:
             # fit returning means "checkpoints are on disk" — join the
             # async writers, then barrier so EVERY pod process's shards
