@@ -1,0 +1,72 @@
+"""Small host-side utilities.
+
+Parity surface: reference zoo/.../common/Utils.scala:32-70
+(``listLocalFiles``, ``saveBytes``, ``logUsageErrorAndThrowException``)
+and the log-redirection helpers of nncontext.py:37-38
+(``redire_spark_logs`` / ``show_bigdl_info_logs`` — here there is no
+Spark/BigDL log firehose, so the helpers manage the framework's own
+logger)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List
+
+log = logging.getLogger("analytics_zoo_tpu")
+
+
+def list_local_files(path: str) -> List[str]:
+    """Recursively list files under ``path`` (Utils.scala:32
+    listLocalFiles/doListLocalFiles)."""
+    out: List[str] = []
+    for root, dirs, files in os.walk(path):
+        dirs.sort()  # deterministic traversal across filesystems
+        for f in sorted(files):
+            out.append(os.path.join(root, f))
+    return out
+
+
+def save_bytes(data: bytes, path: str, is_overwrite: bool = False):
+    """Write bytes to a local file (Utils.scala:52 saveBytes), refusing
+    to clobber unless asked — same contract as the reference."""
+    if os.path.exists(path) and not is_overwrite:
+        raise FileExistsError(
+            f"{path} already exists (pass is_overwrite=True to replace)")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def log_usage_error_and_throw(message: str):
+    """Log then raise — the reference funnels user-facing usage errors
+    through one chokepoint (Utils.scala:56)."""
+    log.error(message)
+    raise ValueError(message)
+
+
+def redirect_logs(path: str, level: int = logging.INFO):
+    """Send the framework's logs to a file (the reference's
+    redire_spark_logs analog)."""
+    handler = logging.FileHandler(path)
+    handler.setLevel(level)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+    log.addHandler(handler)
+    # the logger itself must pass records down, or an unset logger
+    # (inheriting root's WARNING) filters INFO before the handler sees it
+    if log.level == logging.NOTSET or log.level > level:
+        log.setLevel(level)
+    return handler
+
+
+def show_info_logs():
+    """Raise framework log verbosity to INFO on stderr (the reference's
+    show_bigdl_info_logs analog)."""
+    log.setLevel(logging.INFO)
+    # FileHandler subclasses StreamHandler — only a true console handler
+    # satisfies this function's purpose
+    if not any(type(h) is logging.StreamHandler for h in log.handlers):
+        log.addHandler(logging.StreamHandler())

@@ -1,0 +1,167 @@
+"""Sharding-strategy comparison: step time + collective mix per strategy.
+
+Round-2 review flagged the parallelism strategies as "correctness-tested
+but performance-blind": the dryrun proves each strategy lowers, but
+nothing compared them.  This module compiles the SAME training step under
+each strategy on the current mesh and reports, per strategy:
+
+* measured step wall-time (after warm-up);
+* the collective operations GSPMD inserted (all-reduce / all-gather /
+  reduce-scatter / collective-permute counts from the optimized HLO) —
+  the communication structure the "How to Scale Your Model" recipe says
+  to inspect;
+* XLA cost-model flops and peak memory estimate when available.
+
+Usage (works on the virtual CPU mesh — SURVEY §4's local-cluster trick):
+
+    python -m analytics_zoo_tpu.parallel.strategy_report
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+               "collective-permute", "all-to-all")
+
+
+def _collective_counts(hlo_text: str) -> Dict[str, int]:
+    counts = {}
+    for op in COLLECTIVES:
+        # count op instructions (start variants cover async collectives)
+        n = len(re.findall(rf"\b{op}(?:-start)?(?:\.\d+)?\s*=", hlo_text))
+        if n:
+            counts[op] = n
+    return counts
+
+
+def compare_strategies(mesh=None,
+                       strategies: Sequence[str] = ("replicate", "fsdp",
+                                                    "fsdp_tp"),
+                       batch: Optional[int] = None, image_size: int = 32,
+                       num_classes: int = 16, steps: int = 3,
+                       tp_rules=None, model_fn=None) -> Dict:
+    """Compile + run a train step under each strategy on ``mesh`` and
+    measure.  ``model_fn(input_shape, num_classes) -> Model`` defaults to
+    the tiny ResNet-50.  Returns {strategy: {...metrics}}."""
+    from . import mesh as mesh_lib
+    from . import sharding as sharding_lib
+    from ..pipeline.api.keras import objectives
+    from ..train.trainer import build_train_step
+    import optax
+
+    if model_fn is None:
+        from ..models.image.classification import resnet50
+        model_fn = resnet50
+
+    mesh = mesh or mesh_lib.get_default_mesh()
+    dp = mesh_lib.dp_size(mesh)
+    batch = batch or max(dp * 2, 8)
+    model = model_fn(input_shape=(image_size, image_size, 3),
+                     num_classes=num_classes)
+    graph = model.to_graph()
+    loss_fn = objectives.get("sparse_categorical_crossentropy")
+    optimizer = optax.sgd(1e-2, momentum=0.9)
+    step_fn = build_train_step(graph, loss_fn, optimizer, jit=False)
+    rng = np.random.default_rng(0)
+    x_host = rng.normal(size=(batch, image_size, image_size, 3)).astype(
+        np.float32)
+    y_host = rng.integers(0, num_classes, batch).astype(np.int32)
+    batch_sharding = mesh_lib.data_sharding(mesh)
+    repl = mesh_lib.replicated(mesh)
+    key = jax.random.PRNGKey(0)
+
+    report: Dict[str, Dict] = {}
+    for strategy in strategies:
+        params, state = graph.init(jax.random.PRNGKey(0))
+        shardings = sharding_lib.shard_params(
+            params, mesh, strategy,
+            **({"tp_rules": tp_rules or {r"fc1000/W": 1}}
+               if strategy in ("tensor", "fsdp_tp") else {}),
+            **({"fsdp_min_size": 2 ** 10}
+               if strategy in ("fsdp", "fsdp_tp") else {}))
+        params = jax.tree_util.tree_map(jax.device_put, params, shardings)
+        state = jax.device_put(state, repl)
+        # optimizer state initialized from PLACED params so its moment
+        # buffers share their shardings (same convention as the Trainer)
+        # — the AOT executable requires outputs fed back as inputs to
+        # keep exactly these shardings
+        opt_state = jax.tree_util.tree_map(
+            lambda leaf: (leaf if isinstance(leaf, jax.Array)
+                          and hasattr(leaf.sharding, "spec")
+                          else jax.device_put(leaf, repl)),
+            optimizer.init(params))
+        x = jax.device_put(x_host, batch_sharding)
+        y = jax.device_put(y_host, batch_sharding)
+        jitted = jax.jit(step_fn)
+        compiled = jitted.lower(params, state, opt_state, key, x,
+                                y).compile()
+        entry: Dict = {}
+        try:
+            entry["collectives"] = _collective_counts(compiled.as_text())
+        except Exception:
+            entry["collectives"] = None
+        try:
+            cost = compiled.cost_analysis()
+            c = cost[0] if isinstance(cost, (list, tuple)) else cost
+            if c:
+                entry["flops"] = float(c.get("flops", 0))
+                entry["bytes_accessed"] = float(c.get("bytes accessed", 0))
+        except Exception:
+            pass
+        try:
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                entry["temp_bytes"] = int(
+                    getattr(mem, "temp_size_in_bytes", 0))
+                entry["argument_bytes"] = int(
+                    getattr(mem, "argument_size_in_bytes", 0))
+        except Exception:
+            pass
+        # warm-up + timed steps through the AOT executable (calling
+        # jitted(...) would re-trace and compile a second time)
+        params, state, opt_state, loss = compiled(params, state,
+                                                  opt_state, key, x, y)
+        _ = float(loss)
+        t0 = time.time()
+        for _i in range(steps):
+            params, state, opt_state, loss = compiled(params, state,
+                                                      opt_state, key, x, y)
+        _ = float(loss)
+        entry["step_ms"] = round((time.time() - t0) / steps * 1e3, 2)
+        # bytes of parameters each device holds (the fsdp win)
+        entry["per_device_param_bytes"] = int(sum(
+            leaf.addressable_shards[0].data.nbytes
+            for leaf in jax.tree_util.tree_leaves(params)))
+        report[strategy] = entry
+        del params, state, opt_state
+    return {"mesh": dict(mesh.shape), "batch": batch,
+            "device_kind": getattr(jax.devices()[0], "device_kind",
+                                   jax.devices()[0].platform),
+            "strategies": report}
+
+
+def main():
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    from . import mesh as mesh_lib
+    mesh = mesh_lib.create_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+    mesh_lib.set_default_mesh(mesh)
+    print(json.dumps(compare_strategies(mesh), indent=2))
+
+
+if __name__ == "__main__":
+    main()

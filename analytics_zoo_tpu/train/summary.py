@@ -111,6 +111,27 @@ class SummaryWriter:
         return list(self._history.get(tag, []))
 
 
+def read_scalars(log_dir: str, app_name: str, tag: str,
+                 split: str = "train") -> List[Tuple[int, float]]:
+    """Read a PAST run's scalars back from disk (the reference's
+    TrainSummary.readScalar works on saved logs; the in-memory
+    ``read_scalar`` only covers the live writer).  Reads the jsonl
+    sidecar, so no TF dependency is needed to plot a finished run."""
+    path = os.path.join(log_dir, app_name, split, "scalars.jsonl")
+    out: List[Tuple[int, float]] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a crashed writer
+            if rec.get("tag") == tag:
+                out.append((int(rec["step"]), float(rec["value"])))
+    return out
+
+
 class TrainSummary(SummaryWriter):
     """Scalars: Loss, LearningRate, Throughput (parity with BigDL)."""
 

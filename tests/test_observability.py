@@ -1,0 +1,74 @@
+"""Observability parity: reading scalars back from saved runs
+(TrainSummary.readScalar analog) and the common Utils helpers
+(Utils.scala:32-70, nncontext.py:37-38 log helpers)."""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.utils import (list_local_files,
+                                            log_usage_error_and_throw,
+                                            redirect_logs, save_bytes,
+                                            show_info_logs)
+from analytics_zoo_tpu.train.summary import TrainSummary, read_scalars
+
+
+def test_read_scalars_from_saved_run(tmp_path):
+    w = TrainSummary(str(tmp_path), "run1")
+    for step, v in [(1, 2.0), (2, 1.5), (3, 1.1)]:
+        w.add_scalar("Loss", v, step)
+    w.add_scalar("Throughput", 100.0, 3)
+    w.flush()
+    w.close()
+    # a NEW process/reader sees the same history from disk
+    got = read_scalars(str(tmp_path), "run1", "Loss")
+    assert got == [(1, 2.0), (2, 1.5), (3, 1.1)]
+    assert read_scalars(str(tmp_path), "run1", "Throughput") == [(3, 100.0)]
+    assert read_scalars(str(tmp_path), "run1", "absent") == []
+    assert read_scalars(str(tmp_path), "nope", "Loss") == []
+
+
+def test_fit_scalars_round_trip(tmp_path):
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    m = Sequential()
+    m.add(Dense(4, input_shape=(4,)))
+    m.compile(optimizer="sgd", loss="mean_squared_error")
+    m.set_tensorboard(str(tmp_path), "fitrun")
+    rs = np.random.RandomState(0)
+    m.fit(rs.rand(32, 4).astype(np.float32),
+          rs.rand(32, 4).astype(np.float32), batch_size=8, nb_epoch=2)
+    losses = read_scalars(str(tmp_path), "fitrun", "Loss")
+    assert len(losses) == 8  # 4 steps x 2 epochs
+    assert [s for s, _ in losses] == list(range(1, 9))
+
+
+def test_utils_helpers(tmp_path):
+    (tmp_path / "a").mkdir()
+    (tmp_path / "a" / "f2.txt").write_text("x")
+    (tmp_path / "f1.txt").write_text("y")
+    files = list_local_files(str(tmp_path))
+    assert [os.path.basename(f) for f in files] == ["f1.txt", "f2.txt"]
+
+    p = str(tmp_path / "out" / "blob.bin")
+    save_bytes(b"hello", p)
+    assert open(p, "rb").read() == b"hello"
+    with pytest.raises(FileExistsError):
+        save_bytes(b"again", p)
+    save_bytes(b"again", p, is_overwrite=True)
+    assert open(p, "rb").read() == b"again"
+
+    with pytest.raises(ValueError, match="bad usage"):
+        log_usage_error_and_throw("bad usage")
+
+    h = redirect_logs(str(tmp_path / "log.txt"))
+    try:
+        show_info_logs()
+        logging.getLogger("analytics_zoo_tpu").info("hello-log")
+        h.flush()
+        assert "hello-log" in open(str(tmp_path / "log.txt")).read()
+    finally:
+        logging.getLogger("analytics_zoo_tpu").removeHandler(h)

@@ -240,6 +240,10 @@ SKIPS = {
     "ConstantLayer": "autograd constant node; covered by test_autograd",
     "QuantizedDense": "int8 inference wrapper; covered by test_quantize",
     "QuantizedConv": "int8 inference wrapper; covered by test_quantize",
+    "QuantizedEmbedding": "int8 inference wrapper; covered by "
+                          "test_quantize",
+    "QuantizedSeparableConv": "int8 inference wrapper; covered by "
+                              "test_quantize",
     "TFNet": "frozen-graph net; covered by test_tf_interop",
     "OnnxNet": "onnx-imported net; covered by test_onnx",
 }

@@ -1,0 +1,48 @@
+"""Sharding-strategy performance report (round-2 weak #7: strategies were
+correctness-tested but performance-blind).  On the virtual 8-device mesh
+the report must expose the structural differences: replicate AllReduces
+gradients, fsdp additionally all-gathers parameters, and fsdp shrinks
+each device's parameter bytes."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+from analytics_zoo_tpu.parallel.strategy_report import compare_strategies
+
+
+def _small_model(input_shape=(16, 16, 3), num_classes=8):
+    from analytics_zoo_tpu.core.graph import Input
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Dense, Flatten)
+
+    inp = Input(input_shape, name="image")
+    x = Convolution2D(8, 3, 3, activation="relu", border_mode="same")(inp)
+    x = Flatten()(x)
+    x = Dense(256, activation="relu", name="body")(x)
+    x = Dense(num_classes, name="head")(x)
+    return Model(input=inp, output=x, name="small")
+
+
+def test_report_exposes_strategy_differences():
+    mesh = mesh_lib.create_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+    report = compare_strategies(
+        mesh, strategies=("replicate", "fsdp"), batch=16, image_size=16,
+        num_classes=8, steps=2, model_fn=_small_model,
+        tp_rules={r"head/W": 1})
+    assert report["mesh"] == {"data": 2, "fsdp": 2, "tensor": 2}
+    strat = report["strategies"]
+    assert set(strat) == {"replicate", "fsdp"}
+    for entry in strat.values():
+        assert entry["step_ms"] > 0
+        assert entry["collectives"], entry
+    # DP gradients synchronize via all-reduce in both
+    assert strat["replicate"]["collectives"].get("all-reduce", 0) >= 1
+    # fsdp must gather sharded params (all-gather) and/or reduce-scatter
+    fsdp_c = strat["fsdp"]["collectives"]
+    assert fsdp_c.get("all-gather", 0) + fsdp_c.get("reduce-scatter", 0) \
+        >= 1, fsdp_c
+    # fsdp shrinks per-device parameter residency
+    assert strat["fsdp"]["per_device_param_bytes"] < \
+        strat["replicate"]["per_device_param_bytes"]
