@@ -100,7 +100,18 @@ def compare_strategies(mesh=None,
             optimizer.init(params))
         x = jax.device_put(x_host, batch_sharding)
         y = jax.device_put(y_host, batch_sharding)
-        jitted = jax.jit(step_fn)
+        # pin outputs to the input shardings: the step is state→state, so
+        # forcing the fixed point keeps the AOT executable's fed-back
+        # arguments valid (GSPMD may otherwise re-shard e.g. a momentum
+        # leaf on output and the exact-sharding AOT call rejects it)
+        sh_of = lambda leaf: (leaf.sharding
+                              if isinstance(leaf, jax.Array)
+                              and hasattr(leaf.sharding, "spec") else repl)
+        out_sh = (jax.tree_util.tree_map(sh_of, params),
+                  jax.tree_util.tree_map(sh_of, state),
+                  jax.tree_util.tree_map(sh_of, opt_state),
+                  repl)
+        jitted = jax.jit(step_fn, out_shardings=out_sh)
         compiled = jitted.lower(params, state, opt_state, key, x,
                                 y).compile()
         entry: Dict = {}
