@@ -5,11 +5,11 @@ load_bigdl / load_caffe / load_torch / load_tf / load_keras) and GraphNet
 (pyzoo/zoo/pipeline/api/net.py:43-108: new_graph, freeze_up_to, unfreeze,
 to_keras; scala trait NetUtils.scala:216-277).
 
-Import policy (SURVEY §7 non-goals + §2.9): the framework's own format
-loads natively; TF interop is replaced by jax-native functions served via
-``InferenceModel.load_jax`` (there is no embedded TF runtime to port —
-TFNet's JNI session was the thing being replaced); Caffe/Torch-legacy
-formats are dead and raise with guidance.
+Import policy (SURVEY §7 + §2.9): the framework's own format loads
+natively; Keras models and frozen TF graphs import through the GraphDef→
+jax converter (TFNet) — no embedded TF runtime at inference time;
+pytorch state_dicts transfer through the layout converter; only the dead
+legacy formats (Caffe, Torch7 .t7 archives) raise with guidance.
 """
 
 from __future__ import annotations
@@ -36,11 +36,53 @@ class Net:
 
     @staticmethod
     def load_keras(json_path: Optional[str] = None,
-                   hdf5_path: Optional[str] = None):
-        raise NotImplementedError(
-            "Keras-1 HDF5 import is not supported in the TPU build; "
-            "define the model with analytics_zoo_tpu.pipeline.api.keras "
-            "(same layer surface) and load weights via checkpoints")
+                   hdf5_path: Optional[str] = None,
+                   input_shape: Optional[Sequence[int]] = None):
+        """Import a Keras model (reference Net.load_keras): the model is
+        loaded with tf.keras (.h5 / .keras / SavedModel dir, or a
+        json+hdf5 pair), frozen to a GraphDef, and wrapped as a
+        :class:`TFNet` layer running on the jax graph converter — no TF
+        runtime at inference time."""
+        import tensorflow as tf
+
+        if json_path is not None:
+            with open(json_path) as f:
+                km = tf.keras.models.model_from_json(f.read())
+            if hdf5_path is not None:
+                km.load_weights(hdf5_path)
+        elif hdf5_path is not None:
+            km = tf.keras.models.load_model(hdf5_path, compile=False)
+        else:
+            raise ValueError("pass json_path and/or hdf5_path")
+        return Net.from_tf_keras(km, input_shape=input_shape)
+
+    @staticmethod
+    def from_tf_keras(keras_model, input_shape: Optional[Sequence[int]]
+                      = None):
+        """Freeze a LIVE tf.keras model into a TFNet layer."""
+        import tensorflow as tf
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2)
+        from .tfgraph.net import TFNet
+
+        if input_shape is None:
+            # respect each input's declared dtype (int inputs feeding an
+            # Embedding must not trace as float placeholders)
+            specs = [tf.TensorSpec([None] + list(t.shape[1:]), t.dtype)
+                     for t in keras_model.inputs]
+        else:
+            specs = [tf.TensorSpec([None] + list(input_shape),
+                                   keras_model.inputs[0].dtype
+                                   if getattr(keras_model, "inputs", None)
+                                   else tf.float32)]
+        fn = tf.function(lambda *a: keras_model(a[0] if len(a) == 1
+                                                else list(a)))
+        cf = fn.get_concrete_function(*specs)
+        frozen = convert_variables_to_constants_v2(cf)
+        gd = frozen.graph.as_graph_def()
+        return TFNet(graph_def=gd,
+                     input_names=[t.name for t in frozen.inputs],
+                     output_names=[t.name for t in frozen.outputs])
 
     @staticmethod
     def load_caffe(def_path: str, model_path: str):
@@ -50,10 +92,27 @@ class Net:
             "weights)")
 
     @staticmethod
-    def load_torch(path: str):
-        raise NotImplementedError(
-            "Torch7 .t7 import is not supported in the TPU build; for "
-            "pytorch interop convert weights to a checkpoint pytree")
+    def load_torch(path: str, net=None):
+        """Torch interop: with ``net`` given, ``path`` is loaded with
+        ``torch.load`` as a state_dict and transferred into ``net`` via
+        the layout converter (models/weight_loading.py).  Legacy Torch7
+        .t7 archives (the reference's actual format) stay unsupported —
+        the module structure cannot be rebuilt from weights alone."""
+        if net is None:
+            raise NotImplementedError(
+                "Torch7 .t7 import is not supported in the TPU build; "
+                "pass net= (a structurally matching model) to load a "
+                "pytorch state_dict into it via "
+                "models.weight_loading.load_torch_state_dict")
+        import torch
+        try:
+            sd = torch.load(path, map_location="cpu", weights_only=True)
+        except Exception as e:
+            raise ValueError(
+                f"could not load {path!r} as a state_dict "
+                f"(save with torch.save(model.state_dict(), path)): {e}")
+        from ...models.weight_loading import load_torch_state_dict
+        return load_torch_state_dict(net, sd)
 
     @staticmethod
     def load_onnx(path: str):
@@ -65,12 +124,15 @@ class Net:
         return load_onnx(path)
 
     @staticmethod
-    def load_tf(path: str):
-        raise NotImplementedError(
-            "Frozen-GraphDef import is replaced in the TPU build: wrap "
-            "the computation as a jax function and serve it with "
-            "InferenceModel.load_jax (the reference's TFNet existed to "
-            "embed a TF runtime, which this framework replaces outright)")
+    def load_tf(path: str, input_names: Optional[Sequence[str]] = None,
+                output_names: Optional[Sequence[str]] = None):
+        """Import a frozen TF graph (reference Net.load_tf / TFNet
+        folder format): an export folder (pb + graph_meta.json) or a raw
+        .pb with explicit input/output names, converted to jax ops — no
+        embedded TF runtime."""
+        from .tfgraph.net import TFNet
+        return TFNet(path=path, input_names=input_names,
+                     output_names=output_names)
 
 
 class GraphNet(Model):

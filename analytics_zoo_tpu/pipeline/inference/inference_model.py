@@ -90,6 +90,32 @@ class InferenceModel:
                      trainer.state.model_state)
         return self
 
+    def load_tf(self, path: Optional[str] = None, net=None,
+                input_names=None, output_names=None):
+        """Serve a frozen TF graph or imported keras model (reference
+        AbstractInferenceModel.loadTF): ``path`` loads an export folder /
+        .pb via TFNet, or pass an existing TFNet (e.g. from
+        Net.load_keras / Net.from_tf_keras) as ``net``."""
+        from ..api.tfgraph.net import TFNet
+        if net is None:
+            if path is None:
+                raise ValueError("load_tf: pass path= (export folder / "
+                                 ".pb) or net= (an existing TFNet)")
+            net = TFNet(path=path, input_names=input_names,
+                        output_names=output_names)
+        params = net.init_params(jax.random.PRNGKey(0), None)
+
+        def run(p, x):
+            xs = x if isinstance(x, (tuple, list)) else (x,)
+            # frozen graphs may retain dropout nodes; pin the key (same
+            # policy as TFNet.predict)
+            out = net.fn(p, *xs, rng=jax.random.PRNGKey(0))
+            if isinstance(out, (tuple, list)) and len(out) == 1:
+                return out[0]  # single-output graphs return the array
+            return out
+
+        return self.load_jax(run, params)
+
     def load_jax(self, fn, params):
         """Serve a raw jax function fn(params, x) (the TFNet-equivalent
         import path for externally-defined computations)."""

@@ -1,0 +1,117 @@
+"""Net.load_keras / load_tf / load_torch loaders (reference
+Net.scala:89-189): external models import as TFNet layers / via the
+torch layout converter — previously declared policy stubs."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.net import Net
+
+
+def _keras_model(tf):
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((12,)),
+        tf.keras.layers.Dense(8, activation="relu"),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    return km
+
+
+def test_load_keras_h5_round_trip(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    km = _keras_model(tf)
+    path = str(tmp_path / "model.keras")
+    km.save(path)
+    net = Net.load_keras(hdf5_path=path)
+    x = np.random.RandomState(0).rand(4, 12).astype(np.float32)
+    want = km(x).numpy()
+    got = np.asarray(net.predict(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_from_tf_keras_live_model():
+    tf = pytest.importorskip("tensorflow")
+    km = _keras_model(tf)
+    net = Net.from_tf_keras(km)
+    x = np.random.RandomState(1).rand(6, 12).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.predict(x)), km(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_serve_imported_model_multi_input():
+    """InferenceModel.load_tf must unpack multi-input batches the way
+    TFNet.predict does."""
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    a = tf.keras.layers.Input((4,))
+    b = tf.keras.layers.Input((3,))
+    out = tf.keras.layers.Dense(2)(
+        tf.keras.layers.Concatenate()([a, b]))
+    km = tf.keras.Model([a, b], out)
+    net = Net.from_tf_keras(km)
+    serving = InferenceModel()
+    serving.load_tf(net=net)
+    rs = np.random.RandomState(0)
+    x1 = rs.rand(5, 4).astype(np.float32)
+    x2 = rs.rand(5, 3).astype(np.float32)
+    got = np.asarray(serving.predict((x1, x2)))
+    want = km([x1, x2]).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="pass path"):
+        InferenceModel().load_tf()
+
+
+def test_load_tf_frozen_pb(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    import tensorflow.compat.v1 as tf1
+    g = tf1.Graph()
+    with g.as_default():
+        x = tf1.placeholder(tf.float32, [None, 5], name="inp")
+        w = tf1.get_variable("w", [5, 2])
+        out = tf1.nn.softmax(tf1.matmul(x, w), name="out")
+        with tf1.Session(graph=g) as sess:
+            sess.run(tf1.global_variables_initializer())
+            xv = np.random.RandomState(0).rand(3, 5).astype(np.float32)
+            want = sess.run(out, {x: xv})
+            gd = tf1.graph_util.convert_variables_to_constants(
+                sess, g.as_graph_def(), ["out"])
+    pb = str(tmp_path / "frozen.pb")
+    with open(pb, "wb") as f:
+        f.write(gd.SerializeToString())
+    net = Net.load_tf(pb, input_names=["inp:0"], output_names=["out:0"])
+    np.testing.assert_allclose(np.asarray(net.predict(xv)), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_load_torch_state_dict_file(tmp_path):
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    t = nn.Sequential(nn.Linear(6, 4), nn.ReLU(), nn.Linear(4, 2))
+    path = str(tmp_path / "weights.pt")
+    torch.save(t.state_dict(), path)
+
+    ours = Sequential()
+    ours.add(Dense(4, activation="relu", input_shape=(6,)))
+    ours.add(Dense(2))
+    Net.load_torch(path, net=ours)
+    x = np.random.RandomState(0).rand(3, 6).astype(np.float32)
+    with torch.no_grad():
+        want = t(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.asarray(ours.predict(x)), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_load_torch_without_net_still_guides():
+    with pytest.raises(NotImplementedError, match="load_torch_state_dict"):
+        Net.load_torch("/nonexistent.t7")
+
+
+def test_load_caffe_still_stub():
+    with pytest.raises(NotImplementedError, match="Caffe"):
+        Net.load_caffe("a.prototxt", "b.caffemodel")

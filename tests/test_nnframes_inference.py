@@ -132,8 +132,10 @@ def test_inference_model_errors():
     im = InferenceModel()
     with pytest.raises(RuntimeError, match="no model loaded"):
         im.predict(np.zeros((1, 2)))
-    with pytest.raises(NotImplementedError, match="TFNet|load_jax"):
-        Net.load_tf("/nonexistent")
+    # load_tf is implemented now (TFNet import); a bare .pb still needs
+    # explicit tensor names
+    with pytest.raises(ValueError, match="input_names"):
+        Net.load_tf("/nonexistent.pb")
     with pytest.raises(NotImplementedError):
         Net.load_caffe("a", "b")
 
