@@ -73,6 +73,10 @@ def init_nncontext(conf: Optional[ZooTpuConfig] = None,
     global _CONTEXT
     if _CONTEXT is not None:
         return _CONTEXT
+    if isinstance(conf, str):
+        # reference parity: init_nncontext("App Name") treats a bare
+        # string conf as the application name (nncontext.py:32-33)
+        conf, app_name = None, app_name or conf
     conf = conf or ZooTpuConfig()
     if app_name:
         conf.app_name = app_name

@@ -3,6 +3,11 @@ from .textclassification import TextClassifier
 from .recommendation import (Recommender, NeuralCF, WideAndDeep,
                              UserItemFeature, UserItemPrediction,
                              ColumnFeatureInfo)
+from .recommendation_utils import (hash_bucket, categorical_from_vocab_list,
+                                   get_boundaries, get_negative_samples,
+                                   get_wide_tensor, get_deep_tensor,
+                                   row_to_feature, to_user_item_feature,
+                                   features_to_arrays)
 from .image.classification import ImageClassifier, resnet50, label_output
 from .image.detection import (ObjectDetector, ssd_vgg16, ssd_mobilenet,
                               decode_output, ScaleDetection, visualize)

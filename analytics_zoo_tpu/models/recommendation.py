@@ -54,6 +54,7 @@ class ColumnFeatureInfo:
     embed_in_dims: Sequence[int] = ()
     embed_out_dims: Sequence[int] = ()
     continuous_cols: Sequence[str] = ()
+    label: str = "label"
 
 
 class Recommender(ZooModel):

@@ -170,6 +170,17 @@ class MAE(Metric):
         return {"sum": jnp.zeros(()), "total": jnp.zeros(())}
 
     def update(self, acc, y_true, y_pred, mask=None):
+        if y_pred.ndim == y_true.ndim + 1:
+            if y_pred.shape[-1] > 1:
+                # class-distribution output vs integer label (the
+                # reference NCF notebook validates a 5-class log-softmax
+                # with MAE): compare the predicted class to the label
+                y_pred = jnp.argmax(y_pred, axis=-1).astype(jnp.float32)
+                y_true = y_true.astype(jnp.float32)
+            else:
+                # (N, 1) regression head vs (N,) target: align ranks so
+                # the subtraction doesn't broadcast to (N, N)
+                y_pred = y_pred.squeeze(-1)
         err = jnp.abs(y_true - y_pred)
         w = _sample_mask(mask, err.shape[0] if err.ndim else 1)
         w = w.reshape((-1,) + (1,) * (err.ndim - 1))

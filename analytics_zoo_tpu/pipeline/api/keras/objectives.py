@@ -68,6 +68,22 @@ def sparse_categorical_crossentropy(y_true, y_pred):
         logp, labels[..., None], axis=-1).squeeze(-1)
 
 
+def class_nll(y_true, y_pred):
+    """y_true int labels (zero-based), y_pred LOG-probabilities.
+
+    Parity: BigDL ClassNLLCriterion paired with a LogSoftMax output —
+    the reference's NeuralCF/WideAndDeep training criterion
+    (apps/recommendation-ncf notebook, NeuralCF.scala log-softmax head).
+    Use this, not sparse_categorical_crossentropy (which expects
+    probabilities), for models whose final activation is log_softmax.
+    """
+    labels = jnp.squeeze(y_true).astype(jnp.int32)
+    if labels.ndim == 0:
+        labels = labels[None]
+    return -jnp.take_along_axis(
+        y_pred, labels[..., None], axis=-1).squeeze(-1)
+
+
 def hinge(y_true, y_pred):
     return _batch_mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
 
@@ -117,6 +133,8 @@ _LOSSES = {
     "binary_crossentropy": binary_crossentropy,
     "categorical_crossentropy": categorical_crossentropy,
     "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "class_nll": class_nll,
+    "classnll": class_nll,
     "hinge": hinge,
     "squared_hinge": squared_hinge,
     "poisson": poisson,

@@ -175,8 +175,11 @@ class InferenceModel:
         if isinstance(inputs, np.ndarray):
             return inputs, False, False
         if isinstance(inputs, tuple):
-            # tuple = multi-input batch (one array per model input)
-            return tuple(np.asarray(a, dtype=np.float32) for a in inputs), \
+            # tuple = multi-input batch (one array per model input);
+            # keep integer dtypes — embedding/gather inputs must stay int
+            return tuple(
+                a if isinstance(a, np.ndarray)
+                else np.asarray(a, dtype=np.float32) for a in inputs), \
                 False, False
         if isinstance(inputs, list):
             if inputs and isinstance(inputs[0], JTensor):

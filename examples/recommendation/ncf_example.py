@@ -34,8 +34,9 @@ def main():
     model = NeuralCF(user_count=args.users, item_count=args.items,
                      num_classes=5, mf_embed=8,
                      user_embed=8, item_embed=8, hidden_layers=(16, 8))
-    model.compile(optimizer="adam",
-                  loss="sparse_categorical_crossentropy",
+    # the model head is log-softmax: pair it with ClassNLL (reference
+    # parity), NOT sparse_categorical_crossentropy (expects probs)
+    model.compile(optimizer="adam", loss="class_nll",
                   metrics=["accuracy"])
     model.fit(x, y, batch_size=64, nb_epoch=args.epochs)
     print("train metrics:", model.evaluate(x, y, batch_size=64))

@@ -47,8 +47,8 @@ def main():
 
     model = WideAndDeep(model_type=args.model_type, num_classes=2,
                         column_info=info, hidden_layers=(16, 8))
-    model.compile(optimizer="adam",
-                  loss="sparse_categorical_crossentropy",
+    # log-softmax head -> ClassNLL criterion (reference parity)
+    model.compile(optimizer="adam", loss="class_nll",
                   metrics=["accuracy"])
     x = {"wide": [wide, deep], "deep": [deep],
          "wide_n_deep": [wide, deep]}[args.model_type]

@@ -81,10 +81,23 @@ class TestApps:
         out = run_example("apps/image-augmentation-3d/augmentation_3d.py")
         assert "3d augmentation done: 3 volumes" in out
 
+    def test_recommendation_ncf_app(self):
+        out = run_example("apps/recommendation-ncf/ncf_explicit_feedback.py",
+                          "--epochs", "2", "--ratings", "1024")
+        assert "ncf app done" in out
+        assert "top-3 items per user" in out
+        assert "val MAE per epoch" in out  # summaries round-trip from disk
+
+    def test_recommendation_wnd_app(self):
+        out = run_example("apps/recommendation-wide-n-deep/wide_n_deep.py",
+                          "--epochs", "2", "--ratings", "1024")
+        assert "wide-n-deep app done" in out
+        assert "top-3 users per item" in out
+
     def test_transfer_learning_weights_actually_transfer(self):
         # regression for transfer_weights_from: frozen-backbone task B
         # must beat chance by a wide margin
-        out = run_example("apps/transfer-learning/transfer_learning.py",
+        out = run_example("apps/dogs-vs-cats/transfer_learning.py",
                           "--epochs", "3")
         import re
         m = re.search(r"task B \(frozen backbone\): \{'accuracy': ([0-9.]+)",

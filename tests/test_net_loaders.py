@@ -64,6 +64,30 @@ def test_serve_imported_model_multi_input():
         InferenceModel().load_tf()
 
 
+def test_serve_imported_model_int_inputs():
+    """Regression: _normalize must not cast int id inputs to float32 —
+    a served embedding model's gather needs integer indices."""
+    tf = pytest.importorskip("tensorflow")
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    ids = tf.keras.layers.Input((3,), dtype="int32")
+    feats = tf.keras.layers.Input((4,))
+    emb = tf.keras.layers.Flatten()(
+        tf.keras.layers.Embedding(10, 2)(ids))
+    out = tf.keras.layers.Dense(2)(
+        tf.keras.layers.Concatenate()([emb, feats]))
+    km = tf.keras.Model([ids, feats], out)
+    net = Net.from_tf_keras(km)
+    serving = InferenceModel()
+    serving.load_tf(net=net)
+    rs = np.random.RandomState(0)
+    xi = rs.randint(0, 10, (5, 3)).astype(np.int32)
+    xf = rs.rand(5, 4).astype(np.float32)
+    got = np.asarray(serving.predict((xi, xf)))
+    want = km([xi, xf]).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
 def test_load_tf_frozen_pb(tmp_path):
     tf = pytest.importorskip("tensorflow")
     import tensorflow.compat.v1 as tf1

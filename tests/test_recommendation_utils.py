@@ -1,0 +1,146 @@
+"""Feature-assembly helper tests (reference
+pyzoo/zoo/models/recommendation/utils.py semantics)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models import (
+    ColumnFeatureInfo, NeuralCF, categorical_from_vocab_list,
+    features_to_arrays, get_boundaries, get_deep_tensor,
+    get_negative_samples, get_wide_tensor, hash_bucket, row_to_feature,
+    to_user_item_feature)
+
+
+def test_hash_bucket_stable_and_bounded():
+    ids = [hash_bucket(f"k{i}", bucket_size=10, start=1) for i in range(200)]
+    assert all(1 <= i <= 10 for i in ids)
+    # stable across calls (crc32, unlike randomized python hash())
+    assert ids == [hash_bucket(f"k{i}", 10, 1) for i in range(200)]
+    # spreads over the buckets
+    assert len(set(ids)) == 10
+
+
+def test_categorical_from_vocab_list():
+    assert categorical_from_vocab_list("M", ["F", "M"], start=1) == 2
+    assert categorical_from_vocab_list("X", ["F", "M"], default=-1,
+                                       start=1) == 0
+
+
+def test_get_boundaries():
+    assert get_boundaries(25, [20, 30, 40]) == 1
+    assert get_boundaries(55, [20, 30, 40]) == 3
+    assert get_boundaries("?", [20, 30, 40], default=-1, start=1) == 0
+
+
+def test_negative_samples_avoid_positives():
+    pos = [(1, 1), (1, 2), (2, 3)]
+    negs = get_negative_samples(pos, item_count=10, neg_per_pos=2, seed=0)
+    assert len(negs) == 6
+    pos_set = set(pos)
+    for u, i in negs:
+        assert (u, i) not in pos_set
+        assert 1 <= i <= 10
+
+
+def _column_info():
+    return ColumnFeatureInfo(
+        wide_base_cols=["occ", "gen"], wide_base_dims=[21, 3],
+        wide_cross_cols=["cross"], wide_cross_dims=[100],
+        indicator_cols=["genre", "gen"], indicator_dims=[5, 3],
+        embed_cols=["userId", "itemId"], embed_in_dims=[50, 40],
+        embed_out_dims=[8, 8], continuous_cols=["age"], label="label")
+
+
+def test_wide_tensor_offsets():
+    row = {"occ": 4, "gen": 1, "cross": 7}
+    np.testing.assert_array_equal(
+        get_wide_tensor(row, _column_info()),
+        # 4, 21+1, 21+3+7 — each id offset into the concatenated space
+        np.array([4, 22, 31], np.int32))
+
+
+def test_deep_tensor_layout():
+    row = {"genre": 2, "gen": 1, "userId": 7, "itemId": 9, "age": 33.0}
+    deep = get_deep_tensor(row, _column_info())
+    assert deep.shape == (5 + 3 + 2 + 1,)
+    # indicator multi-hot: genre slot 2, gender slot 5+1
+    assert deep[2] == 1.0 and deep[6] == 1.0 and deep.sum() == \
+        pytest.approx(2.0 + 7 + 9 + 33.0)
+    np.testing.assert_array_equal(deep[8:], [7.0, 9.0, 33.0])
+
+
+def test_deep_tensor_multihot_list():
+    ci = ColumnFeatureInfo(indicator_cols=["genres"], indicator_dims=[6])
+    deep = get_deep_tensor({"genres": [0, 3, 5]}, ci)
+    np.testing.assert_array_equal(deep, [1, 0, 0, 1, 0, 1])
+
+
+def test_row_to_feature_model_types():
+    row = {"occ": 1, "gen": 1, "cross": 3, "genre": 0,
+           "userId": 2, "itemId": 3, "age": 20.0}
+    assert len(row_to_feature(row, _column_info(), "wide_n_deep")) == 2
+    assert len(row_to_feature(row, _column_info(), "wide")) == 1
+    with pytest.raises(TypeError):
+        row_to_feature(row, _column_info(), "bogus")
+
+
+def test_to_user_item_feature_and_stacking():
+    ci = _column_info()
+    rows = [{"userId": u, "itemId": u + 1, "occ": u % 21, "gen": u % 3,
+             "cross": u % 100, "genre": u % 5, "age": 20.0 + u,
+             "label": u % 5} for u in range(1, 9)]
+    pairs = [to_user_item_feature(r, ci) for r in rows]
+    assert pairs[0].user_id == 1 and pairs[0].item_id == 2
+    assert pairs[3].label == 4 % 5
+    x, y = features_to_arrays(pairs)
+    assert x[0].shape == (8, 3) and x[1].shape == (8, 11)
+    np.testing.assert_array_equal(y, [r["label"] for r in rows])
+
+
+def test_class_nll_matches_manual():
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+    logp = jnp.log(jnp.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]))
+    y = jnp.array([0, 1])
+    loss = objectives.get("class_nll")(y, logp)
+    np.testing.assert_allclose(np.asarray(loss),
+                               [-np.log(0.7), -np.log(0.8)], rtol=1e-6)
+
+
+def test_mae_metric_class_output_vs_regression():
+    """MAE on a class-distribution output compares argmax class to the
+    label; on a (N, 1) regression head it must NOT argmax (which would
+    zero every prediction) but broadcast-compare values."""
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.pipeline.api.keras.metrics import MAE
+    m = MAE()
+    # 3-class distribution vs int labels -> |argmax - label|
+    acc = m.update(m.init(), jnp.array([0, 2]),
+                   jnp.array([[0.1, 0.8, 0.1], [0.1, 0.1, 0.8]]))
+    assert float(m.result(acc)) == pytest.approx((1 + 0) / 2)
+    # regression head (N, 1) vs (N,) targets: plain absolute error
+    acc = m.update(m.init(), jnp.array([1.0, 2.0]),
+                   jnp.array([[1.5], [2.0]]))
+    assert float(m.result(acc)) == pytest.approx(0.25)
+
+
+def test_ncf_class_nll_actually_learns():
+    """Regression: sparse_categorical_crossentropy on a log-softmax head
+    pinned the loss at -ln(eps)=16.118 and never learned; class_nll is
+    the correct criterion for the recommender heads."""
+    import analytics_zoo_tpu as zoo
+    zoo.init_nncontext()
+    rng = np.random.default_rng(0)
+    users = rng.integers(1, 21, 512)
+    items = rng.integers(1, 21, 512)
+    y = ((users + items) % 2).astype(np.int32)
+    x = np.stack([users, items], axis=1).astype(np.int32)
+    model = NeuralCF(user_count=20, item_count=20, num_classes=2,
+                     user_embed=8, item_embed=8, hidden_layers=(16, 8),
+                     include_mf=False)
+    model.compile(optimizer={"name": "adam", "lr": 5e-3},
+                  loss="class_nll", metrics=["accuracy"])
+    model.fit(x, y, batch_size=64, nb_epoch=8)
+    res = model.evaluate(x, y, batch_size=64)
+    assert res["loss"] < 0.5, res
+    assert res["accuracy"] > 0.85, res
