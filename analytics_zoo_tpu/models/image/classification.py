@@ -21,7 +21,7 @@ from ...pipeline.api.keras.engine import Model
 from ...pipeline.api.keras.layers import (
     Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
     Dropout, Flatten, GlobalAveragePooling2D, MaxPooling2D, Merge,
-    SeparableConvolution2D, ZeroPadding2D)
+    SeparableConvolution2D, SpaceToDepth2D, ZeroPadding2D)
 from ..common import (QuantizedVariantMixin, ZooModel, parse_quantize_name,
                       register_zoo_model)
 
@@ -50,12 +50,26 @@ def _bottleneck(x, filters, stride=1, downsample=False, prefix=""):
     return Activation("relu")(out)
 
 
-def resnet50(input_shape=(224, 224, 3), num_classes=1000) -> Model:
+def resnet50(input_shape=(224, 224, 3), num_classes=1000,
+             space_to_depth=False) -> Model:
     """ResNet-50 v1 (the reference registry's 'resnet-50',
-    ImageClassificationConfig.scala:40)."""
+    ImageClassificationConfig.scala:40).
+
+    ``space_to_depth=True`` swaps the 7x7/s2 C=3 stem for the MLPerf-TPU
+    formulation: pack 2x2 pixel blocks into channels, then a 4x4/s1 C=12
+    conv (asymmetric pad (2,1)) — numerically equivalent to the standard
+    stem under ``space_to_depth_stem_kernel``, but the contraction dim
+    rises 147→192 and the filter-gradient conv stops being the MXU's
+    worst case.  Everything after the stem is identical.
+    """
     inp = Input(input_shape, name="image")
-    x = ZeroPadding2D(padding=(3, 3))(inp)
-    x = _conv_bn(x, 64, 7, stride=2, padding="valid", name="conv1")
+    if space_to_depth:
+        x = SpaceToDepth2D(block_size=2)(inp)
+        x = ZeroPadding2D(padding=(2, 1, 2, 1))(x)
+        x = _conv_bn(x, 64, 4, padding="valid", name="conv1")
+    else:
+        x = ZeroPadding2D(padding=(3, 3))(inp)
+        x = _conv_bn(x, 64, 7, stride=2, padding="valid", name="conv1")
     x = ZeroPadding2D(padding=(1, 1))(x)
     x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
     stages = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
@@ -67,6 +81,26 @@ def resnet50(input_shape=(224, 224, 3), num_classes=1000) -> Model:
     x = GlobalAveragePooling2D()(x)
     x = Dense(num_classes, activation="softmax", name="fc1000")(x)
     return Model(input=inp, output=x, name="resnet50")
+
+
+def space_to_depth_stem_kernel(w, block_size=2):
+    """Convert a standard stem conv kernel (kh, kw, C, O in HWIO) into
+    the equivalent packed kernel for the ``space_to_depth=True`` stem.
+
+    Zero-pads the kernel at the top-left to a multiple of the block,
+    then folds each block's taps into the packed channel dim using the
+    same (r * b + s) * C + c ordering as ``SpaceToDepth2D``.  With this
+    kernel the packed stem is numerically identical to the standard
+    7x7/s2 stem (see test_space_to_depth_stem_equivalence).
+    """
+    import jax.numpy as jnp
+    kh, kw, c, o = w.shape
+    b = block_size
+    ph, pw = (-kh) % b, (-kw) % b
+    w_pad = jnp.pad(jnp.asarray(w), ((ph, 0), (pw, 0), (0, 0), (0, 0)))
+    w_pack = w_pad.reshape((kh + ph) // b, b, (kw + pw) // b, b, c, o)
+    w_pack = jnp.transpose(w_pack, (0, 2, 1, 3, 4, 5))
+    return w_pack.reshape((kh + ph) // b, (kw + pw) // b, b * b * c, o)
 
 
 # ---------------------------------------------------------------- VGG

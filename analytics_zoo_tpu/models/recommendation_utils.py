@@ -34,9 +34,10 @@ def hash_bucket(content, bucket_size: int = 1000, start: int = 0) -> int:
 
 def categorical_from_vocab_list(value, vocab_list: Sequence,
                                 default: int = -1, start: int = 0) -> int:
-    if value in vocab_list:
+    try:
         return list(vocab_list).index(value) + start
-    return default + start
+    except ValueError:
+        return default + start
 
 
 def get_boundaries(target, boundaries: Sequence[float],
@@ -81,14 +82,25 @@ def get_negative_samples(indexed: Sequence[Tuple[int, int]],
 
 
 def get_wide_tensor(row, column_info: ColumnFeatureInfo) -> np.ndarray:
-    """Offset each wide column's id into the concatenated wide space."""
+    """Offset each wide column's id into the concatenated wide space.
+
+    Raises on ids outside [0, dim) — an out-of-range id (e.g. the -1 an
+    unhandled OOV default produces) would otherwise silently land in an
+    adjacent column's bucket range.
+    """
     cols = list(column_info.wide_base_cols) + list(column_info.wide_cross_cols)
     dims = list(column_info.wide_base_dims) + list(column_info.wide_cross_dims)
     ids, acc = [], 0
     for i, col in enumerate(cols):
         if i > 0:
             acc += dims[i - 1]
-        ids.append(acc + int(row[col]))
+        v = int(row[col])
+        if not 0 <= v < dims[i]:
+            raise ValueError(
+                f"wide column {col!r}: id {v} outside [0, {dims[i]}) — "
+                f"reserve an OOV bucket (e.g. default=0, start=1 with "
+                f"dim+1) instead of letting unknowns go negative")
+        ids.append(acc + v)
     return np.asarray(ids, dtype=np.int32)
 
 
@@ -106,7 +118,13 @@ def get_deep_tensor(row, column_info: ColumnFeatureInfo) -> np.ndarray:
         val = row[col]
         for v in (val if isinstance(val, (list, tuple, set, np.ndarray))
                   else (val,)):
-            deep[acc + int(v)] = 1.0
+            v = int(v)
+            if not 0 <= v < ind_dims[i]:
+                raise ValueError(
+                    f"indicator column {col!r}: id {v} outside "
+                    f"[0, {ind_dims[i]}) — would corrupt a neighboring "
+                    f"feature slot; reserve an OOV bucket instead")
+            deep[acc + v] = 1.0
     for i, col in enumerate(tail_cols):
         deep[sum(ind_dims) + i] = float(row[col])
     return deep

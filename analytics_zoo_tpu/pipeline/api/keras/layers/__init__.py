@@ -7,7 +7,8 @@ from .convolutional import (
     AtrousConvolution2D, ShareConvolution2D, SeparableConvolution2D,
     Deconvolution2D, LocallyConnected1D, LocallyConnected2D,
     ZeroPadding1D, ZeroPadding2D, ZeroPadding3D, Cropping1D, Cropping2D,
-    Cropping3D, UpSampling1D, UpSampling2D, UpSampling3D, ResizeBilinear)
+    Cropping3D, UpSampling1D, UpSampling2D, UpSampling3D, ResizeBilinear,
+    SpaceToDepth2D)
 from .pooling import (
     MaxPooling1D, MaxPooling2D, MaxPooling3D, AveragePooling1D,
     AveragePooling2D, AveragePooling3D, GlobalMaxPooling1D,

@@ -41,7 +41,12 @@ class JTensor:
 def _to_ndarray(x):
     if isinstance(x, JTensor):
         return x.to_ndarray()
-    return np.asarray(x, dtype=np.float32)
+    a = np.asarray(x)
+    # keep integer dtypes (embedding/gather ids must stay int); float64
+    # narrows to the framework's working f32
+    if np.issubdtype(a.dtype, np.integer):
+        return a
+    return a.astype(np.float32, copy=False)
 
 
 class InferenceModel:
@@ -176,11 +181,11 @@ class InferenceModel:
             return inputs, False, False
         if isinstance(inputs, tuple):
             # tuple = multi-input batch (one array per model input);
-            # keep integer dtypes — embedding/gather inputs must stay int
+            # _to_ndarray keeps integer dtypes — embedding/gather inputs
+            # must stay int
             return tuple(
-                a if isinstance(a, np.ndarray)
-                else np.asarray(a, dtype=np.float32) for a in inputs), \
-                False, False
+                a if isinstance(a, np.ndarray) else _to_ndarray(a)
+                for a in inputs), False, False
         if isinstance(inputs, list):
             if inputs and isinstance(inputs[0], JTensor):
                 jtensor = True
@@ -195,7 +200,7 @@ class InferenceModel:
                     for i in range(n_inputs)), single, jtensor
             arrs = [_to_ndarray(t) for t in inputs]
             return np.stack(arrs), single, jtensor
-        return np.asarray(inputs, dtype=np.float32), False, False
+        return _to_ndarray(inputs), False, False
 
     def __repr__(self):
         loaded = self._predict_fn is not None

@@ -114,6 +114,8 @@ CASES = {
                      (4, 4, 2), None),
     "UpSampling3D": (lambda s: L.UpSampling3D((2, 2, 2), input_shape=s),
                      (3, 3, 3, 2), None),
+    "SpaceToDepth2D": (lambda s: L.SpaceToDepth2D(2, input_shape=s),
+                       (4, 4, 3), None),
     "ResizeBilinear": (
         lambda s: L.ResizeBilinear(output_height=6, output_width=7,
                                    input_shape=s), (4, 5, 2), None),

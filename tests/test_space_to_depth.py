@@ -1,0 +1,82 @@
+"""Space-to-depth stem: layer semantics + exact ResNet-50 stem
+equivalence (the MLPerf-TPU stem formulation)."""
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import SpaceToDepth2D
+from analytics_zoo_tpu.models.image.classification import (
+    resnet50, space_to_depth_stem_kernel)
+
+
+def test_space_to_depth_packing_order():
+    zoo.init_nncontext()
+    x = np.arange(1 * 4 * 4 * 3, dtype=np.float32).reshape(1, 4, 4, 3)
+    m = Sequential()
+    m.add(SpaceToDepth2D(block_size=2, input_shape=(4, 4, 3)))
+    y = np.asarray(m.predict(x, batch_size=1))
+    assert y.shape == (1, 2, 2, 12)
+    # packed channel (r*2+s)*C + c must equal X[2u+r, 2v+s, c]
+    for u in range(2):
+        for v in range(2):
+            for r in range(2):
+                for s in range(2):
+                    for c in range(3):
+                        assert y[0, u, v, (r * 2 + s) * 3 + c] == \
+                            x[0, 2 * u + r, 2 * v + s, c]
+
+
+def test_space_to_depth_rejects_indivisible():
+    zoo.init_nncontext()
+    m = Sequential()
+    m.add(SpaceToDepth2D(block_size=2, input_shape=(5, 4, 3)))
+    with pytest.raises(ValueError, match="not divisible"):
+        m.predict(np.zeros((1, 5, 4, 3), np.float32), batch_size=1)
+
+
+def test_space_to_depth_stem_kernel_shape():
+    w = np.random.RandomState(0).randn(7, 7, 3, 64).astype(np.float32)
+    packed = np.asarray(space_to_depth_stem_kernel(w))
+    assert packed.shape == (4, 4, 12, 64)
+    # the zero-padded first row/col of the 8x8 kernel land in block
+    # offsets r=0 / s=0: channels (r*2+s)*3+c with r=0 are 0..5, with
+    # s=0 are 0..2 and 6..8
+    assert np.all(packed[0, :, 0:6, :] == 0)   # row tap 0, r=0 channels
+    assert np.all(packed[:, 0, 0:3, :] == 0)   # col tap 0, s=0 channels
+    assert np.all(packed[:, 0, 6:9, :] == 0)
+    # and the real taps survive: W7[0,0] -> W8[1,1] -> tap (0,0), (r=1,s=1)
+    np.testing.assert_array_equal(packed[0, 0, 9:12, :], w[0, 0])
+
+
+def test_resnet50_space_to_depth_stem_equivalence():
+    """The packed stem with the converted kernel must reproduce the
+    standard 7x7/s2 stem bit-for-bit (up to float assoc)."""
+    zoo.init_nncontext()
+    rs = np.random.RandomState(0)
+    std = resnet50(input_shape=(64, 64, 3), num_classes=10)
+    s2d = resnet50(input_shape=(64, 64, 3), num_classes=10,
+                   space_to_depth=True)
+    w = std.get_weights()
+    w2 = {k: dict(v) for k, v in w.items()}
+    w2["conv1"] = {"W": np.asarray(space_to_depth_stem_kernel(
+        w["conv1"]["W"]))}
+    s2d.set_weights(w2)
+    x = rs.rand(4, 64, 64, 3).astype(np.float32)
+    out_std = np.asarray(std.predict(x, batch_size=4))
+    out_s2d = np.asarray(s2d.predict(x, batch_size=4))
+    np.testing.assert_allclose(out_s2d, out_std, rtol=1e-4, atol=1e-5)
+
+
+def test_resnet50_space_to_depth_trains():
+    zoo.init_nncontext()
+    m = resnet50(input_shape=(32, 32, 3), num_classes=4,
+                 space_to_depth=True)
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    rs = np.random.RandomState(0)
+    x = rs.rand(16, 32, 32, 3).astype(np.float32)
+    y = rs.randint(0, 4, 16).astype(np.int32)
+    hist = m.fit(x, y, batch_size=8, nb_epoch=1)
+    assert np.isfinite(hist["loss"][-1])
