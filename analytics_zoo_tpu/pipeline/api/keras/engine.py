@@ -45,6 +45,7 @@ class KerasNet(Layer):
         self._inference_only = False
         self._compile_args: Optional[dict] = None
         self._tensorboard: Optional[tuple] = None
+        self._summary_triggers: dict = {}
         self._checkpoint: Optional[tuple] = None
         self._clip_norm = None
         self._clip_value = None
@@ -89,6 +90,7 @@ class KerasNet(Layer):
                 pass
         if self._tensorboard:
             self.trainer.set_tensorboard(*self._tensorboard)
+            self._apply_summary_triggers()
         if self._checkpoint:
             self.trainer.set_checkpoint(*self._checkpoint)
         self._compile_args = {"optimizer": optimizer, "loss": loss,
@@ -118,6 +120,33 @@ class KerasNet(Layer):
             self.trainer.set_tensorboard(log_dir, app_name,
                                          profile=profile,
                                          profile_steps=profile_steps)
+            self._apply_summary_triggers()
+
+    @property
+    def train_summary(self):
+        """The live TrainSummary writer — reference getTrainSummary.
+        ``None`` until both set_tensorboard and compile have run; use
+        ``set_summary_trigger`` on the model to queue a trigger at any
+        point."""
+        return None if self.trainer is None else self.trainer.train_summary
+
+    def set_summary_trigger(self, tag: str, trigger):
+        """Throttle a summary tag (BigDL setSummaryTrigger).  Safe to
+        call before compile/set_tensorboard — the trigger is applied to
+        the TrainSummary as soon as it exists."""
+        self._summary_triggers[tag] = trigger
+        if self.train_summary is not None:
+            self.train_summary.set_summary_trigger(tag, trigger)
+        return self
+
+    def _apply_summary_triggers(self):
+        if self.train_summary is not None:
+            for tag, trig in self._summary_triggers.items():
+                self.train_summary.set_summary_trigger(tag, trig)
+
+    @property
+    def val_summary(self):
+        return None if self.trainer is None else self.trainer.val_summary
 
     def set_checkpoint(self, path: str, over_write: bool = True):
         self._checkpoint = (path, over_write)
@@ -156,10 +185,13 @@ class KerasNet(Layer):
             end_trigger=trigger_lib.MaxEpoch(start_epoch + nb_epoch),
             validation_data=val_ds, shuffle=shuffle, verbose=verbose)
 
-    def evaluate(self, x, y=None, batch_size: int = 32) -> Dict[str, float]:
+    def evaluate(self, x, y=None, batch_size: int = 32,
+                 metrics=None) -> Dict[str, float]:
+        """``metrics`` overrides the compiled metric set for this call
+        (reference evaluate(rdd, batch, valMethods), Topology.scala:353)."""
         self._require_compiled()
         ds = x if isinstance(x, Dataset) else Dataset.from_ndarray(x, y)
-        return self.trainer.evaluate(ds, batch_size)
+        return self.trainer.evaluate(ds, batch_size, metrics=metrics)
 
     def predict(self, x, batch_size: int = 32, distributed: bool = True):
         self.ensure_inference_ready()
@@ -329,6 +361,41 @@ class KerasNet(Layer):
         text = "\n".join(lines)
         print(text)
         return text
+
+    def save_graph_topology(self, log_path: str) -> str:
+        """Write the model's graph topology for inspection — parity with
+        the reference's ``saveGraphTopology`` (Topology.scala:536-546,
+        which exports the graph to a TensorBoard log dir).
+
+        Emits two files under ``log_path``:
+        ``graph_topology.txt`` (node -> inputs with shapes, in topological
+        order) and ``graph_topology.dot`` (Graphviz; render with
+        ``dot -Tpng``).  Returns ``log_path``.
+        """
+        graph = self.to_graph()
+        os.makedirs(log_path, exist_ok=True)
+
+        def _label(v):
+            kind = type(v.layer).__name__ if v.layer is not None else "Input"
+            return f"{v.name} [{kind}] {tuple(v.shape) if v.shape else ''}"
+
+        lines = [f"model: {self.name}", ""]
+        for v in graph.nodes:
+            src = ", ".join(i.name for i in v.inputs) or "(graph input)"
+            lines.append(f"{_label(v)}  <-  {src}")
+        with open(os.path.join(log_path, "graph_topology.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+        dot = ["digraph model {", "  rankdir=TB;",
+               '  node [shape=box, fontsize=10];']
+        for v in graph.nodes:
+            dot.append(f'  n{v.node_id} [label="{_label(v)}"];')
+            for i in v.inputs:
+                dot.append(f"  n{i.node_id} -> n{v.node_id};")
+        dot.append("}")
+        with open(os.path.join(log_path, "graph_topology.dot"), "w") as f:
+            f.write("\n".join(dot) + "\n")
+        return log_path
 
     # ---- layer delegation so a compiled net can be nested as a Layer ----
     def init(self, rng, input_shape=None):

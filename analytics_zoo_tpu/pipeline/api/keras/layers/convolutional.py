@@ -738,8 +738,15 @@ class SpaceToDepth2D(_PadCropBase):
         b = self.block_size
         if self.data_format == "channels_first":
             n, c, h, w = input_shape
+        else:
+            n, h, w, c = input_shape
+        if (h is not None and h % b) or (w is not None and w % b):
+            # fail at model construction, not deep inside the jit trace
+            raise ValueError(
+                f"SpaceToDepth2D: spatial dims ({h}, {w}) not divisible "
+                f"by block_size {b}")
+        if self.data_format == "channels_first":
             return (n, c * b * b, h // b, w // b)
-        n, h, w, c = input_shape
         return (n, h // b, w // b, c * b * b)
 
     def get_config(self):

@@ -192,6 +192,86 @@ class MAE(Metric):
         return acc["sum"] / jnp.maximum(acc["total"], 1)
 
 
+class _RankingMetric(Metric):
+    """Shared machinery for grouped ranking metrics (BigDL HitRatio /
+    NDCG, bigdl.optim ValidationMethods used by implicit-feedback NCF).
+
+    The evaluation batch is consecutive groups of ``1 + neg_num``
+    user-item pairs — one positive (label 1) and ``neg_num`` sampled
+    negatives (label 0), the layout ``get_negative_samples`` produces.
+    The positive's rank among its group's scores decides the credit.
+    Batches must be a multiple of the group size; a masked (padded)
+    sample voids its whole group.
+    """
+
+    _base_name = "ranking"
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = int(k)
+        self.neg_num = int(neg_num)
+        # result key encodes k (BigDL names its results "HitRate@10");
+        # two instances at different k therefore don't collide
+        self.name = f"{self._base_name}@{self.k}"
+
+    def init(self):
+        return {"sum": jnp.zeros(()), "total": jnp.zeros(())}
+
+    def _rank_and_weight(self, y_true, y_pred, mask):
+        group = self.neg_num + 1
+        if y_pred.ndim >= 2 and y_pred.shape[-1] > 1:
+            # class-distribution output (e.g. 2-class log-softmax):
+            # score = last column (the "interaction" class)
+            y_pred = y_pred[..., -1]
+        scores = y_pred.reshape(-1)
+        labels = y_true.reshape(-1)
+        n = scores.shape[0]
+        if n % group:
+            raise ValueError(
+                f"{self.name}: batch of {n} pairs is not a multiple of "
+                f"group size 1+neg_num={group}")
+        w = _sample_mask(mask, n).reshape(-1, group)
+        g_scores = scores.reshape(-1, group)
+        g_labels = labels.reshape(-1, group).astype(jnp.float32)
+        # positive's score per group (one label-1 row per group)
+        pos = jnp.sum(g_scores * g_labels, axis=1)
+        rank = 1 + jnp.sum(
+            (g_scores > pos[:, None]) & (g_labels < 0.5), axis=1)
+        g_w = jnp.min(w, axis=1)  # padded tail voids the group
+        return rank, g_w
+
+    def result(self, acc):
+        return acc["sum"] / jnp.maximum(acc["total"], 1)
+
+
+class HitRatio(_RankingMetric):
+    """hit@k over (1 positive + neg_num negatives) groups — parity with
+    BigDL ``HitRatio(k, negNum)``.  Result key: ``hit_ratio@k``."""
+
+    _base_name = "hit_ratio"
+
+    def update(self, acc, y_true, y_pred, mask=None):
+        rank, w = self._rank_and_weight(y_true, y_pred, mask)
+        hits = (rank <= self.k).astype(jnp.float32)
+        return {"sum": acc["sum"] + jnp.sum(hits * w),
+                "total": acc["total"] + jnp.sum(w)}
+
+
+class NDCG(_RankingMetric):
+    """Normalized discounted cumulative gain at k for a single positive
+    per group — parity with BigDL ``NDCG(k, negNum)``:
+    ndcg = log(2) / log(1 + rank) when rank <= k else 0.
+    Result key: ``ndcg@k``."""
+
+    _base_name = "ndcg"
+
+    def update(self, acc, y_true, y_pred, mask=None):
+        rank, w = self._rank_and_weight(y_true, y_pred, mask)
+        gain = jnp.where(rank <= self.k,
+                         jnp.log(2.0) / jnp.log(1.0 + rank), 0.0)
+        return {"sum": acc["sum"] + jnp.sum(gain * w),
+                "total": acc["total"] + jnp.sum(w)}
+
+
 def get(name):
     if isinstance(name, Metric):
         return name
@@ -204,4 +284,8 @@ def get(name):
         return AUC()
     if key == "mae":
         return MAE()
+    if key in ("hitratio", "hit_ratio", "hitrate"):
+        return HitRatio()
+    if key == "ndcg":
+        return NDCG()
     raise ValueError(f"Unknown metric {name!r}")

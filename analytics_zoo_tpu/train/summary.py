@@ -84,8 +84,27 @@ class SummaryWriter:
         self._events = open(self._events_path, "ab")
         self._jsonl = open(os.path.join(log_dir, "scalars.jsonl"), "a")
         self._history: Dict[str, List[Tuple[int, float]]] = {}
+        self._triggers: Dict[str, object] = {}
+
+    def set_summary_trigger(self, tag: str, trigger) -> "SummaryWriter":
+        """Throttle how often a tag is recorded — parity with BigDL
+        ``TrainSummary.setSummaryTrigger`` (used by the reference
+        recommendation notebooks: ``set_summary_trigger("Loss",
+        SeveralIteration(1))``).  ``trigger`` is any
+        ``analytics_zoo_tpu.train.triggers.Trigger``; it gates
+        ``add_scalar`` for that tag, whatever the tag is."""
+        self._triggers[tag] = trigger
+        return self
+
+    def should_log(self, tag: str, step: int) -> bool:
+        trig = self._triggers.get(tag)
+        if trig is None:
+            return True
+        return bool(trig({"iteration": int(step)}))
 
     def add_scalar(self, tag: str, value: float, step: int):
+        if not self.should_log(tag, step):
+            return
         wall = time.time()
         record = _scalar_event_proto(step, tag, float(value), wall)
         header = struct.pack("<Q", len(record))

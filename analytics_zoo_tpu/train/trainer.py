@@ -136,6 +136,7 @@ class Trainer:
         self.val_summary: Optional[ValidationSummary] = None
         self._train_step = None
         self._eval_step = None
+        self._eval_step_overrides: Dict[str, Any] = {}
         self._predict_step = None
         self._param_shardings = None
         self._batch_sharding = mesh_lib.data_sharding(self.mesh)
@@ -216,8 +217,9 @@ class Trainer:
         return build_train_step(self.model, self.loss_fn, self.optimizer,
                                 compute_dtype=self.compute_dtype)
 
-    def _build_eval_step(self):
-        model, metrics = self.model, self.metrics
+    def _build_eval_step(self, metrics: Optional[Sequence] = None):
+        model = self.model
+        metrics = self.metrics if metrics is None else list(metrics)
         loss_fn = self.loss_fn
 
         def eval_step(params, model_state, accs, loss_acc, x, y, mask):
@@ -410,6 +412,7 @@ class Trainer:
                 history["loss"].extend(losses_host)
                 elapsed = max(time.time() - epoch_start, 1e-9)
                 if self.train_summary is not None:
+                    # add_scalar self-gates on any set_summary_trigger
                     for i, lossf in enumerate(losses_host):
                         step_i = base_step + i + 1
                         self.train_summary.add_scalar("Loss", lossf, step_i)
@@ -460,15 +463,36 @@ class Trainer:
         return history
 
     # ------------------------------------------------------------------
-    def evaluate(self, dataset: Dataset, batch_size: int) -> Dict[str, float]:
+    def evaluate(self, dataset: Dataset, batch_size: int,
+                 metrics: Optional[Sequence] = None) -> Dict[str, float]:
         """Evaluate over the FULL dataset — the trailing partial batch is
         padded to the compiled batch shape and masked out of every metric,
         so n % batch_size != 0 loses no samples (reference evaluates the
-        whole set, Topology.scala:353)."""
+        whole set, Topology.scala:353).
+
+        ``metrics`` overrides the compiled metric set for this call —
+        parity with the reference's ``evaluate(rdd, batch, valMethods)``
+        (Topology.scala:353); names or Metric instances.
+        """
         self.ensure_initialized()
-        if self._eval_step is None:
-            self._eval_step = self._build_eval_step()
-        accs = [m.init() for m in self.metrics]
+        if metrics is None:
+            use_metrics = self.metrics
+            if self._eval_step is None:
+                self._eval_step = self._build_eval_step()
+            eval_step = self._eval_step
+        else:
+            from ..pipeline.api.keras import metrics as metrics_lib
+            use_metrics = [metrics_lib.get(m) for m in metrics]
+            # cache override steps by metric identity so an epoch loop
+            # with the same valMethods doesn't re-jit the forward pass
+            key = tuple((type(m).__name__, m.name,
+                         getattr(m, "k", None), getattr(m, "neg_num", None))
+                        for m in use_metrics)
+            if self._eval_step_overrides.get("key") != key:
+                self._eval_step_overrides = {
+                    "key": key, "step": self._build_eval_step(use_metrics)}
+            eval_step = self._eval_step_overrides["step"]
+        accs = [m.init() for m in use_metrics]
         loss_acc = {"sum": jnp.zeros(()), "n": jnp.zeros(())}
         dp = mesh_lib.dp_size(self.mesh)
         nproc = dist_lib.process_count()
@@ -518,11 +542,11 @@ class Trainer:
             else:
                 mask_dev = full_mask
             bx, by = self._put_batch(bx, by)
-            accs, loss_acc = self._eval_step(
+            accs, loss_acc = eval_step(
                 self.state.params, self.state.model_state, accs, loss_acc,
                 bx, by, mask_dev)
         results = {m.name: float(m.result(a))
-                   for m, a in zip(self.metrics, accs)}
+                   for m, a in zip(use_metrics, accs)}
         if self.loss_fn is not None and float(loss_acc["n"]) > 0:
             results["loss"] = float(loss_acc["sum"]) / float(loss_acc["n"])
         return results

@@ -87,6 +87,7 @@ class TestApps:
         assert "ncf app done" in out
         assert "top-3 items per user" in out
         assert "val MAE per epoch" in out  # summaries round-trip from disk
+        assert "implicit feedback: HitRatio@3" in out
 
     def test_recommendation_wnd_app(self):
         out = run_example("apps/recommendation-wide-n-deep/wide_n_deep.py",

@@ -46,6 +46,54 @@ def test_fit_scalars_round_trip(tmp_path):
     assert [s for s, _ in losses] == list(range(1, 9))
 
 
+def test_summary_trigger_throttles_tags(tmp_path):
+    """set_summary_trigger parity (reference notebooks:
+    train_summary.set_summary_trigger("Loss", SeveralIteration(n)))."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.train.triggers import SeveralIteration
+
+    m = Sequential()
+    m.add(Dense(4, input_shape=(4,)))
+    # set the trigger BEFORE compile/set_tensorboard — it must queue and
+    # apply once the TrainSummary exists
+    m.set_summary_trigger("Loss", SeveralIteration(4))
+    m.compile(optimizer="sgd", loss="mean_squared_error")
+    m.set_tensorboard(str(tmp_path), "throttled")
+    rs = np.random.RandomState(0)
+    m.fit(rs.rand(32, 4).astype(np.float32),
+          rs.rand(32, 4).astype(np.float32), batch_size=8, nb_epoch=2)
+    losses = read_scalars(str(tmp_path), "throttled", "Loss")
+    assert [s for s, _ in losses] == [4, 8]  # every 4th of 8 steps
+    # untriggered tags are unaffected
+    assert len(read_scalars(str(tmp_path), "throttled", "Throughput")) == 2
+    # every tag is throttleable, including Throughput
+    m.train_summary.set_summary_trigger("Throughput", SeveralIteration(100))
+    m.fit(rs.rand(32, 4).astype(np.float32),
+          rs.rand(32, 4).astype(np.float32), batch_size=8, nb_epoch=1)
+    assert len(read_scalars(str(tmp_path), "throttled", "Throughput")) == 2
+
+
+def test_save_graph_topology(tmp_path):
+    from analytics_zoo_tpu.pipeline.api.keras import Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Merge
+    from analytics_zoo_tpu.core.graph import Input
+
+    inp = Input((6,), name="x")
+    a = Dense(4, name="branch_a")(inp)
+    b = Dense(4, name="branch_b")(inp)
+    out = Merge(mode="sum")([a, b])
+    model = Model(input=inp, output=out, name="fork")
+    path = model.save_graph_topology(str(tmp_path / "tb"))
+    txt = open(os.path.join(path, "graph_topology.txt")).read()
+    assert "branch_a" in txt and "branch_b" in txt
+    assert "(graph input)" in txt
+    dot = open(os.path.join(path, "graph_topology.dot")).read()
+    assert dot.startswith("digraph") and "->" in dot
+    # both branches feed the merge node
+    assert dot.count("->") >= 4
+
+
 def test_utils_helpers(tmp_path):
     (tmp_path / "a").mkdir()
     (tmp_path / "a" / "f2.txt").write_text("x")

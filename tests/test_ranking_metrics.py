@@ -1,0 +1,131 @@
+"""HitRatio@k / NDCG@k ranking metrics (BigDL ValidationMethod parity,
+the implicit-feedback NCF evaluation protocol: rank one positive among
+neg_num sampled negatives)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.pipeline.api.keras.metrics import (HitRatio, NDCG,
+                                                          get)
+
+
+def _grouped(scores_per_group, pos_index_per_group):
+    """Build flat (y_true, y_pred) for groups where the positive sits at
+    the given index with the given score layout."""
+    y_pred, y_true = [], []
+    for scores, pos in zip(scores_per_group, pos_index_per_group):
+        y_pred.extend(scores)
+        y_true.extend(1 if i == pos else 0 for i in range(len(scores)))
+    return (jnp.asarray(y_true, jnp.float32),
+            jnp.asarray(y_pred, jnp.float32))
+
+
+def test_hit_ratio_ranks_positive():
+    m = HitRatio(k=2, neg_num=3)  # groups of 4
+    # group A: positive is the best score -> rank 1, hit
+    # group B: positive is 3rd best -> rank 3, miss at k=2
+    y_true, y_pred = _grouped(
+        [[0.9, 0.1, 0.2, 0.3], [0.4, 0.8, 0.6, 0.1]], [0, 0])
+    acc = m.update(m.init(), y_true, y_pred)
+    assert float(m.result(acc)) == pytest.approx(0.5)
+
+
+def test_ndcg_values():
+    m = NDCG(k=3, neg_num=3)
+    # rank 1 -> log2/log2 = 1.0 ; rank 3 -> log2/log4 = 0.5
+    y_true, y_pred = _grouped(
+        [[0.9, 0.1, 0.2, 0.3], [0.4, 0.8, 0.6, 0.1]], [0, 0])
+    acc = m.update(m.init(), y_true, y_pred)
+    assert float(m.result(acc)) == pytest.approx((1.0 + 0.5) / 2)
+
+
+def test_ranking_metric_class_distribution_output():
+    """(B, 2) log-softmax output: score = last column."""
+    m = HitRatio(k=1, neg_num=1)
+    y_true = jnp.asarray([1, 0, 0, 1], jnp.float32)
+    logp = jnp.log(jnp.asarray(
+        [[0.2, 0.8], [0.6, 0.4],   # group 1: pos wins
+         [0.3, 0.7], [0.4, 0.6]],  # group 2: pos (idx 3) loses
+        jnp.float32))
+    acc = m.update(m.init(), y_true, logp)
+    assert float(m.result(acc)) == pytest.approx(0.5)
+
+
+def test_ranking_metric_mask_voids_group():
+    m = HitRatio(k=1, neg_num=1)
+    y_true, y_pred = _grouped([[0.9, 0.1], [0.2, 0.8]], [0, 0])
+    mask = jnp.asarray([1, 1, 0, 0], jnp.float32)  # second group padded
+    acc = m.update(m.init(), y_true, y_pred, mask)
+    assert float(m.result(acc)) == pytest.approx(1.0)
+    assert float(acc["total"]) == 1.0
+
+
+def test_ranking_metric_bad_batch():
+    m = NDCG(k=2, neg_num=3)
+    with pytest.raises(ValueError, match="not a multiple"):
+        m.update(m.init(), jnp.zeros(6), jnp.zeros(6))
+
+
+def test_get_by_name():
+    m = get("hit_ratio")
+    assert isinstance(m, HitRatio) and m.name == "hit_ratio@10"
+    assert isinstance(get("ndcg"), NDCG)
+
+
+def test_distinct_k_instances_do_not_collide():
+    assert HitRatio(k=1, neg_num=9).name != HitRatio(k=10, neg_num=9).name
+
+
+def test_ncf_implicit_feedback_evaluation():
+    """End-to-end: implicit NCF with negative sampling, evaluated with
+    HitRatio/NDCG through model.evaluate.  A model trained on structured
+    preferences must beat the chance hit rate by a wide margin."""
+    zoo.init_nncontext()
+    from analytics_zoo_tpu.models import (NeuralCF, get_negative_samples)
+
+    rng = np.random.default_rng(0)
+    n_users, n_items = 24, 40
+    # ground truth: user u likes item i iff (u + i) % 4 == 0
+    pos = [(u, i) for u in range(1, n_users + 1)
+           for i in range(1, n_items + 1) if (u + i) % 4 == 0]
+    negs = get_negative_samples(pos, item_count=n_items, neg_per_pos=3,
+                                seed=1)
+    x = np.array(pos + negs, np.int32)
+    y = np.concatenate([np.ones(len(pos)), np.zeros(len(negs))]) \
+        .astype(np.int32)
+    perm = rng.permutation(len(x))
+    model = NeuralCF(user_count=n_users, item_count=n_items, num_classes=2,
+                     user_embed=8, item_embed=8, hidden_layers=(16, 8),
+                     include_mf=True, mf_embed=4)
+    model.compile(optimizer={"name": "adam", "lr": 5e-3}, loss="class_nll")
+    model.fit(x[perm], y[perm], batch_size=64, nb_epoch=12)
+
+    # evaluation protocol: per held-out positive, 1 pos + 9 negatives
+    neg_num = 9
+    eval_x, eval_y = [], []
+    for u, i in pos[:50]:
+        eval_x.append((u, i))
+        eval_y.append(1)
+        drawn = 0
+        j = 1
+        while drawn < neg_num:
+            cand = ((i + j) % n_items) + 1
+            j += 1
+            if (u + cand) % 4 != 0:
+                eval_x.append((u, cand))
+                eval_y.append(0)
+                drawn += 1
+    eval_x = np.array(eval_x, np.int32)
+    eval_y = np.array(eval_y, np.int32)
+    group = neg_num + 1
+    res = model.evaluate(
+        eval_x, eval_y, batch_size=group * 10,
+        metrics=[HitRatio(k=3, neg_num=neg_num),
+                 NDCG(k=3, neg_num=neg_num)])
+    # chance hit@3 of 10 = 0.3; the trained model must do far better
+    assert res["hit_ratio@3"] > 0.6, res
+    assert res["ndcg@3"] > 0.4, res
+    assert 0.0 <= res["ndcg@3"] <= res["hit_ratio@3"] <= 1.0

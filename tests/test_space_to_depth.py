@@ -29,10 +29,12 @@ def test_space_to_depth_packing_order():
 
 
 def test_space_to_depth_rejects_indivisible():
+    """Indivisible spatial dims fail at model construction (shape
+    inference), not deep inside the first jit trace."""
     zoo.init_nncontext()
-    m = Sequential()
-    m.add(SpaceToDepth2D(block_size=2, input_shape=(5, 4, 3)))
     with pytest.raises(ValueError, match="not divisible"):
+        m = Sequential()
+        m.add(SpaceToDepth2D(block_size=2, input_shape=(5, 4, 3)))
         m.predict(np.zeros((1, 5, 4, 3), np.float32), batch_size=1)
 
 
