@@ -103,7 +103,16 @@ class ImageSet:
         return Dataset.from_ndarray(self.to_array(), self.labels())
 
     def set_predictions(self, preds):
-        self.predictions = np.asarray(preds)
+        if (isinstance(preds, list) and preds
+                and isinstance(preds[0], (list, tuple)) and preds[0]
+                and isinstance(preds[0][0], tuple)):
+            # structured per-image results — label_output's
+            # [(label, confidence), ...] lists: keep python objects,
+            # np.asarray would stringify the mixed types.  Plain numeric
+            # list-of-lists still becomes an ndarray below.
+            self.predictions = list(preds)
+        else:
+            self.predictions = np.asarray(preds)
         for f, p in zip(self.features, self.predictions):
             f["predict"] = p
 

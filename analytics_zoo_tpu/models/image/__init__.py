@@ -3,3 +3,6 @@ from .classification import (ImageClassifier, resnet50, vgg16, vgg19,
                              inception_v1, densenet161, label_output)
 from .detection import (ObjectDetector, ssd_vgg16, ssd_mobilenet,
                         decode_output, ScaleDetection, visualize)
+from .config import (ImageConfigure, PaddingParam, read_label_map,
+                     read_imagenet_label_map, read_pascal_label_map,
+                     read_coco_label_map, PASCAL_CLASSES, COCO_CLASSES)

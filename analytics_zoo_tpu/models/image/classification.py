@@ -16,6 +16,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from ...core.graph import Input
 from ...pipeline.api.keras.engine import Model
 from ...pipeline.api.keras.layers import (
@@ -441,10 +443,44 @@ class ImageClassifier(QuantizedVariantMixin, ZooModel):
 
     def predict_image_set(self, image_set, configure=None):
         """predictImageSet parity (ImageModel.scala:45-69): preprocess →
-        predict → attach results."""
-        from ...feature.image.imageset import ImageSet
+        predict → postprocess → attach results.  ``configure`` defaults
+        to the model name's registry entry (ImageConfigure.parse)."""
+        from .config import ImageConfigure
+        model_shape = tuple(self.hyper["input_shape"])
+        if configure is None:
+            shapes = {tuple(f["image"].shape) for f in image_set.features}
+            if shapes == {model_shape}:
+                # images are already model-ready (the pre-registry API
+                # contract): do NOT force registry preprocessing onto
+                # them — resize/normalize on preprocessed tensors would
+                # silently corrupt the predictions
+                configure = ImageConfigure()
+            else:
+                try:
+                    configure = ImageConfigure.parse(
+                        self.hyper["model_name"])
+                except ValueError:
+                    configure = ImageConfigure()
+                if configure.input_size is not None and (
+                        model_shape[0] != configure.input_size
+                        or model_shape[1] != configure.input_size):
+                    # model built at a non-registry (or non-square) input
+                    # size: the canonical preprocessing would emit the
+                    # wrong shape — skip it rather than crash
+                    configure = ImageConfigure(
+                        label_map=configure.label_map,
+                        batch_per_partition=configure.batch_per_partition)
+        if configure.pre_processor is not None:
+            image_set = image_set.transform(configure.pre_processor)
         x = image_set.to_array()
-        probs = self.predict(x, batch_size=32)
+        probs = self.predict(
+            x, batch_size=max(configure.batch_per_partition, 1) * 8)
+        if configure.post_processor is not None:
+            probs = configure.post_processor(probs)
+        elif configure.label_map:
+            probs = label_output(
+                probs, [configure.label_map.get(i, str(i))
+                        for i in range(int(np.shape(probs)[-1]))])
         image_set.set_predictions(probs)
         return image_set
 

@@ -369,13 +369,21 @@ class ObjectDetector(QuantizedVariantMixin, ZooModel):
         model, self._image_size = _DETECTORS[base](h["num_classes"])
         return model
 
-    def predict_image_set(self, image_set, batch_size: int = 8):
+    def predict_image_set(self, image_set, batch_size: int = 8,
+                          configure=None):
         """preprocess → forward → decode → scale, parity with
-        ImageModel.predictImageSet (ImageModel.scala:45-69)."""
+        ImageModel.predictImageSet (ImageModel.scala:45-69).  Pass an
+        ``ImageConfigure`` (e.g. ``ImageConfigure.parse("ssd-vgg16-300")``)
+        to run its pre_processor on raw-sized images first; detections
+        are scaled back to the ORIGINAL image coordinates."""
         h = self.hyper
-        x = image_set.to_array()
+        # original sizes before any preprocessing — detections come back
+        # in these coordinates (reference ScaleDetection semantics)
         heights = [f["image"].shape[0] for f in image_set.features]
         widths = [f["image"].shape[1] for f in image_set.features]
+        if configure is not None and configure.pre_processor is not None:
+            image_set = image_set.transform(configure.pre_processor)
+        x = image_set.to_array()
         raw = self.predict(x, batch_size=batch_size)
         dets = decode_output(
             jnp.asarray(raw), jnp.asarray(self.priors), h["num_classes"],

@@ -162,6 +162,19 @@ class KerasNet(Layer):
         """Parity: Topology.scala:207-213; call before compile."""
         self._clip_value = (float(min_value), float(max_value))
 
+    def clear_gradient_clipping(self):
+        """Parity: Topology.scala:200-205 / topology.py:88; call before
+        compile."""
+        self._clip_norm = None
+        self._clip_value = None
+
+    def get_layer(self, name: str):
+        """Retrieve a layer by its unique name (topology.py:277)."""
+        matches = [l for l in self.to_graph().layers if l.name == name]
+        if not matches:
+            raise ValueError(f"no layer named {name!r}")
+        return matches[0]
+
     def _require_compiled(self):
         if self.trainer is None or self._inference_only:
             raise RuntimeError(
@@ -453,6 +466,20 @@ class Sequential(KerasNet):
                     h = layer(h)
             self._graph = GraphModule(x, h, name=self.name)
         return self._graph
+
+    def to_model(self) -> "Model":
+        """Convert to the functional ``Model`` form
+        (Topology.scala:805 / topology.py:316)."""
+        g = self.to_graph()
+        inp = g.input_vars[0] if len(g.input_vars) == 1 else g.input_vars
+        out = (g.output_vars[0] if g.single_output and
+               len(g.output_vars) == 1 else g.output_vars)
+        model = Model(input=inp, output=out, name=self.name)
+        if self.trainer is not None:
+            model.trainer = self.trainer
+            model._compile_args = self._compile_args
+            model._inference_only = self._inference_only
+        return model
 
     def get_config(self):
         return {

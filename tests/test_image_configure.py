@@ -1,0 +1,121 @@
+"""ImageConfigure registry + label maps (reference image_config.py,
+ImageClassificationConfig.scala:34-50, object_detector.py label maps)."""
+
+import numpy as np
+import pytest
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.models import (ImageClassifier, ImageConfigure,
+                                      read_coco_label_map, read_label_map,
+                                      read_pascal_label_map)
+from analytics_zoo_tpu.feature.image.imageset import ImageSet
+
+
+def test_parse_registry():
+    cfg = ImageConfigure.parse("resnet-50")
+    assert cfg.pre_processor is not None and cfg.input_size == 224
+    assert ImageConfigure.parse("inception-v3").input_size == 299
+    assert ImageConfigure.parse("ssd-vgg16-300").input_size == 300
+    # quantize variants share the base configure
+    assert ImageConfigure.parse("resnet-50-quantize").input_size == 224
+    with pytest.raises(ValueError, match="No default configure"):
+        ImageConfigure.parse("nope")
+
+
+def test_parse_preprocessor_shapes_raw_image():
+    cfg = ImageConfigure.parse("resnet-50")
+    feat = {"image": np.random.RandomState(0).randint(
+        0, 255, (480, 640, 3)).astype(np.float32)}
+    out = cfg.pre_processor(feat)
+    assert out["image"].shape == (224, 224, 3)
+    # imagenet mean subtracted -> values centred near zero
+    assert abs(float(out["image"].mean())) < 60
+
+
+def test_label_maps():
+    pascal = read_pascal_label_map()
+    assert pascal[0] == "__background__" and len(pascal) == 21
+    assert pascal[15] == "person"
+    coco = read_coco_label_map()
+    assert len(coco) == 81 and coco[1] == "person"
+
+
+def test_read_label_map_file(tmp_path):
+    p = tmp_path / "labels.txt"
+    p.write_text("cat\ndog\nfish\n")
+    assert read_label_map(str(p)) == {0: "cat", 1: "dog", 2: "fish"}
+    p2 = tmp_path / "indexed.txt"
+    p2.write_text("7\tseven\n9 nine\n")
+    assert read_label_map(str(p2)) == {7: "seven", 9: "nine"}
+
+
+def test_predict_image_set_with_configure():
+    """End-to-end: raw variable-size images -> registry preprocessing ->
+    model forward, via the default parse path."""
+    zoo.init_nncontext()
+    model = ImageClassifier(model_name="squeezenet",
+                            input_shape=(224, 224, 3), num_classes=7)
+    model.compile(optimizer="sgd", loss="categorical_crossentropy")
+    rs = np.random.RandomState(0)
+    arrays = [rs.randint(0, 255, (300 + 20 * i, 400, 3)).astype(np.float32)
+              for i in range(3)]
+    iset = ImageSet.from_arrays(arrays)
+    result = model.predict_image_set(iset)  # configure=None -> parse
+    preds = result.get_predicts()
+    assert len(preds) == 3
+    assert preds[0][1].shape == (7,)
+
+
+def test_predict_image_set_skips_mismatched_configure():
+    """A model at a non-registry input size must not have the canonical
+    224 preprocessing forced onto it."""
+    zoo.init_nncontext()
+    model = ImageClassifier(model_name="squeezenet",
+                            input_shape=(32, 32, 3), num_classes=5)
+    model.compile(optimizer="sgd", loss="categorical_crossentropy")
+    imgs = np.random.default_rng(0).uniform(
+        0, 1, (4, 32, 32, 3)).astype(np.float32)
+    iset = ImageSet.from_arrays(imgs)
+    preds = model.predict_image_set(iset).get_predicts()
+    assert preds[0][1].shape == (5,)
+
+
+def test_predict_image_set_preserves_ready_inputs():
+    """Regression: already model-shaped (preprocessed) images must NOT
+    get registry preprocessing forced onto them — that would corrupt
+    normalized tensors silently."""
+    zoo.init_nncontext()
+    model = ImageClassifier(model_name="squeezenet",
+                            input_shape=(224, 224, 3), num_classes=3)
+    model.compile(optimizer="sgd", loss="categorical_crossentropy")
+    imgs = np.random.default_rng(0).uniform(
+        0, 1, (2, 224, 224, 3)).astype(np.float32)
+    before = [f["image"].copy() for f in ImageSet.from_arrays(imgs).features]
+    iset = ImageSet.from_arrays(imgs)
+    direct = np.asarray(model.predict(imgs, batch_size=2))
+    preds = model.predict_image_set(iset).get_predicts()
+    np.testing.assert_allclose(preds[0][1], direct[0], rtol=1e-5)
+    np.testing.assert_array_equal(iset.features[0]["image"], before[0])
+
+
+def test_label_map_smaller_than_classes():
+    """Regression: a 21-entry label map over a 1000-class output must
+    fall back to str(i), not IndexError."""
+    zoo.init_nncontext()
+    model = ImageClassifier(model_name="squeezenet",
+                            input_shape=(32, 32, 3), num_classes=50)
+    model.compile(optimizer="sgd", loss="categorical_crossentropy")
+    imgs = np.random.default_rng(0).uniform(
+        0, 1, (2, 32, 32, 3)).astype(np.float32)
+    cfg = ImageConfigure(label_map=read_pascal_label_map())
+    preds = model.predict_image_set(
+        ImageSet.from_arrays(imgs), configure=cfg).get_predicts()
+    labels = [lbl for lbl, _ in preds[0][1]]
+    assert len(labels) == 5 and all(isinstance(l, str) for l in labels)
+
+
+def test_set_predictions_numeric_lists_stay_arrays():
+    iset = ImageSet.from_arrays(
+        np.zeros((2, 4, 4, 3), np.float32))
+    iset.set_predictions([[0.1, 0.9], [0.8, 0.2]])
+    assert iset.get_predicts()[0][1].shape == (2,)

@@ -110,3 +110,31 @@ def test_save_load_roundtrip(tmp_path):
     loaded = load_model(str(tmp_path / "model"))
     out = loaded.predict(x[:64], batch_size=64)
     np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-5)
+
+
+def test_topology_api_parity():
+    """get_layer / to_model / clear_gradient_clipping
+    (topology.py:88,277,316)."""
+    zoo.init_nncontext()
+    x, y = make_data(128)
+    model = build_lenet()
+    model.set_gradient_clipping_by_l2_norm(1.0)
+    model.clear_gradient_clipping()
+    assert model._clip_norm is None and model._clip_value is None
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy")
+    model.fit(x, y, batch_size=64, nb_epoch=1)
+
+    dense = [l for l in model.to_graph().layers
+             if type(l).__name__ == "Dense"][0]
+    assert model.get_layer(dense.name) is dense
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="no layer named"):
+        model.get_layer("nope")
+
+    # Sequential -> functional Model keeps the trained weights
+    as_model = model.to_model()
+    ref = model.predict(x[:32], batch_size=32)
+    out = as_model.predict(x[:32], batch_size=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
