@@ -1,4 +1,4 @@
 from .common import (Preprocessing, ChainedPreprocessing, SeqToTensor,
                      ArrayToTensor, ScalarToTensor, MLlibVectorToTensor,
                      TensorToSample, FeatureLabelPreprocessing,
-                     FeatureToTupleAdapter, BigDLAdapter)
+                     FeatureToTupleAdapter, BigDLAdapter, ToTuple)

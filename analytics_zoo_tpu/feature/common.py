@@ -203,6 +203,17 @@ class FeatureToTupleAdapter(Preprocessing):
 
 
 @register_preprocessing
+class ToTuple(Preprocessing):
+    """Wrap a bare feature into a (feature, None-label) tuple
+    (reference common.py:125 ToTuple)."""
+
+    def apply(self, sample):
+        if isinstance(sample, tuple):
+            return sample
+        return (sample, None)
+
+
+@register_preprocessing
 class BigDLAdapter(Preprocessing):
     """Identity adapter kept for API parity (reference BigDLAdapter.scala
     wraps a BigDL Transformer; here any callable slots in directly)."""

@@ -79,6 +79,15 @@ class ImageSet:
         self.features = [transformer.apply(f) for f in self.features]
         return self
 
+    def copy(self) -> "ImageSet":
+        """Shallow-copy the set with COPIED feature dicts: transforms on
+        the copy reassign keys on the new dicts, so the original set's
+        images survive (arrays are shared until a transform replaces
+        them, never mutated in place)."""
+        new = ImageSet([type(f)(f) for f in self.features])
+        new.predictions = self.predictions
+        return new
+
     # sugar matching the reference's ``imageset -> transformer``
     def __rshift__(self, transformer: Preprocessing) -> "ImageSet":
         return self.transform(transformer)

@@ -10,7 +10,8 @@ from .recommendation_utils import (hash_bucket, categorical_from_vocab_list,
                                    features_to_arrays)
 from .image.classification import ImageClassifier, resnet50, label_output
 from .image.detection import (ObjectDetector, ssd_vgg16, ssd_mobilenet,
-                              decode_output, ScaleDetection, visualize)
+                              decode_output, ScaleDetection, visualize,
+                              Visualizer)
 from .image.config import (ImageConfigure, PaddingParam, read_label_map,
                            read_imagenet_label_map, read_pascal_label_map,
                            read_coco_label_map)

@@ -2,7 +2,8 @@ from .classification import (ImageClassifier, resnet50, vgg16, vgg19,
                              mobilenet, mobilenet_v2, squeezenet,
                              inception_v1, densenet161, label_output)
 from .detection import (ObjectDetector, ssd_vgg16, ssd_mobilenet,
-                        decode_output, ScaleDetection, visualize)
+                        decode_output, ScaleDetection, visualize,
+                        Visualizer)
 from .config import (ImageConfigure, PaddingParam, read_label_map,
                      read_imagenet_label_map, read_pascal_label_map,
                      read_coco_label_map, PASCAL_CLASSES, COCO_CLASSES)

@@ -470,9 +470,12 @@ class ImageClassifier(QuantizedVariantMixin, ZooModel):
                     configure = ImageConfigure(
                         label_map=configure.label_map,
                         batch_per_partition=configure.batch_per_partition)
+        work = image_set
         if configure.pre_processor is not None:
-            image_set = image_set.transform(configure.pre_processor)
-        x = image_set.to_array()
+            # preprocess a COPY: the caller's images must survive (they
+            # are what visualization / other models consume afterwards)
+            work = image_set.copy().transform(configure.pre_processor)
+        x = work.to_array()
         probs = self.predict(
             x, batch_size=max(configure.batch_per_partition, 1) * 8)
         if configure.post_processor is not None:

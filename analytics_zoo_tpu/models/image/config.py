@@ -40,7 +40,8 @@ class ImageConfigure:
     def parse(cls, model_name: str) -> "ImageConfigure":
         """Default configure for a registry model name
         (ImageConfigure.parse / ImageClassificationConfig.scala:52-77)."""
-        base = model_name.replace("-quantize", "")
+        from ..common import parse_quantize_name
+        base, _ = parse_quantize_name(model_name)
         if base not in _CONFIGURES:
             raise ValueError(
                 f"No default configure for {model_name!r}; known: "

@@ -381,9 +381,13 @@ class ObjectDetector(QuantizedVariantMixin, ZooModel):
         # in these coordinates (reference ScaleDetection semantics)
         heights = [f["image"].shape[0] for f in image_set.features]
         widths = [f["image"].shape[1] for f in image_set.features]
+        work = image_set
         if configure is not None and configure.pre_processor is not None:
-            image_set = image_set.transform(configure.pre_processor)
-        x = image_set.to_array()
+            # preprocess a COPY — detections return in ORIGINAL
+            # coordinates, so the original pixels must survive for
+            # Visualizer to draw on
+            work = image_set.copy().transform(configure.pre_processor)
+        x = work.to_array()
         raw = self.predict(x, batch_size=batch_size)
         dets = decode_output(
             jnp.asarray(raw), jnp.asarray(self.priors), h["num_classes"],
@@ -412,3 +416,24 @@ def visualize(image: np.ndarray, detections: np.ndarray,
         draw.text((x1 + 2, y1 + 2), f"{text}:{score:.2f}",
                   fill=(255, 0, 0))
     return np.asarray(img)
+
+
+class Visualizer:
+    """Configured box-drawer over an ImageSet (reference
+    Visualizer.scala): holds label map + threshold, applies
+    ``visualize`` to every (image, detections) pair."""
+
+    def __init__(self, label_map: Optional[Dict[int, str]] = None,
+                 threshold: float = 0.3):
+        self.label_map = label_map
+        self.threshold = threshold
+
+    def __call__(self, image: np.ndarray,
+                 detections: np.ndarray) -> np.ndarray:
+        return visualize(image, detections, label_map=self.label_map,
+                         threshold=self.threshold)
+
+    def visualize_image_set(self, image_set):
+        """Return annotated copies of every image in a predicted set."""
+        return [self(f["image"], f["predict"])
+                for f in image_set.features]

@@ -173,6 +173,10 @@ class KerasNet(Layer):
         matches = [l for l in self.to_graph().layers if l.name == name]
         if not matches:
             raise ValueError(f"no layer named {name!r}")
+        if len(matches) > 1:
+            raise ValueError(
+                f"{len(matches)} layers named {name!r} — names must be "
+                "unique for get_layer")
         return matches[0]
 
     def _require_compiled(self):
@@ -183,10 +187,31 @@ class KerasNet(Layer):
 
     def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 1,
             validation_data=None, shuffle: bool = True,
-            verbose: bool = False):
+            verbose: bool = False, resume: bool = False):
         """x may be a Dataset or ndarray(s); mirrors fit(RDD/ImageSet/
-        DataSet) overloads (Topology.scala:255-330)."""
+        DataSet) overloads (Topology.scala:255-330).
+
+        ``resume=True`` is the failure-recovery path (SURVEY §5: frequent
+        async checkpoints + re-init from latest): when the
+        ``set_checkpoint`` directory holds a snapshot, training state is
+        restored from the newest one — with re-sharding, so a different
+        mesh/strategy works — and ``nb_epoch`` MORE epochs run from
+        there.  A fresh run (no snapshot yet) starts normally, so the
+        same script is crash-safe without edits.
+        """
         self._require_compiled()
+        if resume:
+            if not self._checkpoint:
+                raise ValueError(
+                    "fit(resume=True) needs set_checkpoint(path) first")
+            from ....train.checkpoint import latest_tag
+            ckpt_dir = self._checkpoint[0]
+            if latest_tag(ckpt_dir) is not None:
+                self.trainer.load_weights(ckpt_dir)
+                import logging
+                logging.getLogger("analytics_zoo_tpu").info(
+                    "fit: resumed from %s at epoch %d step %d", ckpt_dir,
+                    self.trainer.state.epoch, self.trainer.state.step)
         ds = x if isinstance(x, Dataset) else Dataset.from_ndarray(x, y)
         val_ds = None
         if validation_data is not None:

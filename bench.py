@@ -192,6 +192,13 @@ def child(platform: str):
         extras["flash_attention"] = {"error": f"{type(e).__name__}: {e}"}
         _log(f"flash attention bench failed: {e}")
 
+    # ---- NCF steps/sec (BASELINE.md north-star metric #3) ----
+    try:
+        extras["ncf"] = _bench_ncf(jax, jnp, np, on_tpu)
+    except Exception as e:
+        extras["ncf"] = {"error": f"{type(e).__name__}: {e}"}
+        _log(f"ncf bench failed: {e}")
+
     baseline = 100.0  # nominal target (no published reference number)
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -253,6 +260,65 @@ def _bench_input_fed(jax, jnp, np, graph, loss_fn, optimizer, batch, size,
     return {"images_per_sec": round(ips, 2), "steps": steps,
             "native_decode": bool(native.available()),
             "transfer_dtype": "uint8", "n_images": n_images}
+
+
+def _bench_ncf(jax, jnp, np, on_tpu: bool):
+    """NCF training steps/sec at the reference notebook's config
+    (MovieLens-1M scale: 6040 users x 3706 items, batch 2800, Adam —
+    apps/recommendation-ncf notebook).  The iteration loop runs inside
+    one jit via lax.scan, same tunnel-floor methodology as the
+    attention bench (PERF_NOTES.md)."""
+    import optax
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+    from analytics_zoo_tpu.train.trainer import build_train_step
+
+    users, items, batch = 6040, 3706, 2800
+    n_steps = 50 if on_tpu else 3
+    model = NeuralCF(user_count=users, item_count=items, num_classes=5,
+                     user_embed=20, item_embed=20,
+                     hidden_layers=(40, 20, 10), include_mf=True,
+                     mf_embed=20)
+    graph = model.to_graph()
+    params, state = graph.init(jax.random.PRNGKey(0))
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+    loss_fn = objectives.get("class_nll")
+    step = build_train_step(graph, loss_fn, optimizer, jit=False)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(np.stack([rng.integers(1, users + 1, batch),
+                              rng.integers(1, items + 1, batch)], axis=1),
+                    dtype=jnp.int32)
+    y = jnp.asarray(rng.integers(0, 5, batch), dtype=jnp.int32)
+    key = jax.random.PRNGKey(0)
+
+    def loop(carry, _):
+        p, s, o = carry
+        p, s, o, loss = step(p, s, o, key, x, y)
+        return (p, s, o), loss
+
+    @jax.jit
+    def run(p, s, o):
+        (p, s, o), losses = jax.lax.scan(loop, (p, s, o), None,
+                                         length=n_steps)
+        return p, s, o, losses[-1]
+
+    params, state, opt_state, loss = run(params, state, opt_state)
+    _ = float(loss)  # compile + warm
+    best = 1e9
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.time()
+        params, state, opt_state, loss = run(params, state, opt_state)
+        _ = float(loss)
+        best = min(best, (time.time() - t0) / n_steps)
+    sps = 1.0 / best
+    _log(f"ncf: {best * 1e3:.3f} ms/step -> {sps:.0f} steps/s "
+         f"({sps * batch:.0f} samples/s) at batch {batch}")
+    return {"steps_per_sec": round(sps, 1), "batch": batch,
+            "samples_per_sec": round(sps * batch, 0),
+            "users": users, "items": items,
+            "method": f"lax.scan x{n_steps} inside one jit"}
 
 
 def _bench_attention(jax, jnp, on_tpu: bool):

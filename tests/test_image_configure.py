@@ -119,3 +119,21 @@ def test_set_predictions_numeric_lists_stay_arrays():
         np.zeros((2, 4, 4, 3), np.float32))
     iset.set_predictions([[0.1, 0.9], [0.8, 0.2]])
     assert iset.get_predicts()[0][1].shape == (2,)
+
+
+def test_predict_image_set_does_not_mutate_raw_images():
+    """Regression: the configure preprocessing must run on a COPY — the
+    caller's raw images survive for visualization/other models, and
+    detections/predictions align with the ORIGINAL pixels."""
+    zoo.init_nncontext()
+    model = ImageClassifier(model_name="squeezenet",
+                            input_shape=(224, 224, 3), num_classes=3)
+    model.compile(optimizer="sgd", loss="categorical_crossentropy")
+    raw = [np.random.default_rng(i).integers(
+        0, 255, (300, 400, 3)).astype(np.float32) for i in range(2)]
+    iset = ImageSet.from_arrays(raw)
+    before = [f["image"].copy() for f in iset.features]
+    model.predict_image_set(iset)  # parse path (raw sizes != model)
+    for f, b in zip(iset.features, before):
+        np.testing.assert_array_equal(f["image"], b)
+    assert iset.get_predicts()[0][1].shape == (3,)

@@ -169,3 +169,43 @@ def test_fit_resume_under_fsdp(tmp_path):
     # the resumed trainer's params still carry the fsdp shardings
     flat = jax.tree_util.tree_leaves(t_b.state.params)
     assert any(getattr(l.sharding, "spec", P()) != P() for l in flat)
+
+
+def test_keras_fit_auto_resume(tmp_path):
+    """fit(resume=True): the crash-recovery one-liner (SURVEY §5).  A
+    fresh run starts normally; a re-run of the SAME script after an
+    interruption restores the newest snapshot and continues epochs."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    def make():
+        m = Sequential()
+        m.add(Dense(4, input_shape=(6,)))
+        m.compile(optimizer="sgd", loss="mean_squared_error")
+        m.set_checkpoint(str(tmp_path / "ckpt"))
+        return m
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 6).astype(np.float32)
+    y = rs.rand(64, 4).astype(np.float32)
+
+    # fresh run: resume=True with an empty dir just starts
+    m1 = make()
+    m1.fit(x, y, batch_size=16, nb_epoch=2, resume=True)
+    assert m1.trainer.state.epoch == 2
+    from analytics_zoo_tpu.train.checkpoint import wait_pending
+    wait_pending()
+
+    # "crashed" -> new process = new model object; same script re-runs
+    m2 = make()
+    m2.fit(x, y, batch_size=16, nb_epoch=3, resume=True)
+    # resumed at epoch 2, trained 3 MORE epochs
+    assert m2.trainer.state.epoch == 5
+
+    # resume without set_checkpoint is a usage error
+    m3 = Sequential()
+    m3.add(Dense(4, input_shape=(6,)))
+    m3.compile(optimizer="sgd", loss="mean_squared_error")
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="set_checkpoint"):
+        m3.fit(x, y, batch_size=16, nb_epoch=1, resume=True)
