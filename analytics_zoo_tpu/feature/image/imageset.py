@@ -83,9 +83,12 @@ class ImageSet:
         """Shallow-copy the set with COPIED feature dicts: transforms on
         the copy reassign keys on the new dicts, so the original set's
         images survive (arrays are shared until a transform replaces
-        them, never mutated in place)."""
-        new = ImageSet([type(f)(f) for f in self.features])
-        new.predictions = self.predictions
+        them, never mutated in place).  Preserves the concrete class and
+        set-level attributes (predictions, label_map, ...)."""
+        new = type(self)([type(f)(f) for f in self.features])
+        for k, v in self.__dict__.items():
+            if k != "features":
+                setattr(new, k, v)
         return new
 
     # sugar matching the reference's ``imageset -> transformer``

@@ -86,6 +86,23 @@ def child(platform: str):
     import numpy as np
     import optax
 
+    child_start = time.time()
+    # optional extras (attention/ncf/int8) only START when their
+    # estimated cost fits in the remaining child budget — the headline
+    # ResNet number and the input-fed mode must always reach the final
+    # json print within the parent's time box, even when the shared chip
+    # is slow (PERF_NOTES.md contention note).  Estimates are generous
+    # multiples of healthy-chip timings.
+    child_budget = 1400.0
+
+    def _extras_budget_left(section: str, est_cost: float) -> bool:
+        spent = time.time() - child_start
+        if spent + est_cost > child_budget:
+            _log(f"skipping {section}: {spent:.0f}s spent + ~{est_cost:.0f}s"
+                 f" est > {child_budget:.0f}s child budget")
+            return False
+        return True
+
     t0 = time.time()
     dev = jax.devices()[0]
     _log(f"backend up in {time.time() - t0:.1f}s: platform={dev.platform} "
@@ -186,18 +203,34 @@ def child(platform: str):
     extras["step_tflops"] = round(step_flops / 1e12, 3)
 
     # ---- pallas flash-attention on-chip microbench (VERDICT r2 #4) ----
-    try:
-        extras["flash_attention"] = _bench_attention(jax, jnp, on_tpu)
-    except Exception as e:
-        extras["flash_attention"] = {"error": f"{type(e).__name__}: {e}"}
-        _log(f"flash attention bench failed: {e}")
+    if _extras_budget_left("flash_attention", 300):
+        try:
+            extras["flash_attention"] = _bench_attention(jax, jnp, on_tpu)
+        except Exception as e:
+            extras["flash_attention"] = {"error": f"{type(e).__name__}: {e}"}
+            _log(f"flash attention bench failed: {e}")
+    else:
+        extras["flash_attention"] = {"skipped": "extras deadline"}
 
     # ---- NCF steps/sec (BASELINE.md north-star metric #3) ----
-    try:
-        extras["ncf"] = _bench_ncf(jax, jnp, np, on_tpu)
-    except Exception as e:
-        extras["ncf"] = {"error": f"{type(e).__name__}: {e}"}
-        _log(f"ncf bench failed: {e}")
+    if _extras_budget_left("ncf", 200):
+        try:
+            extras["ncf"] = _bench_ncf(jax, jnp, np, on_tpu)
+        except Exception as e:
+            extras["ncf"] = {"error": f"{type(e).__name__}: {e}"}
+            _log(f"ncf bench failed: {e}")
+    else:
+        extras["ncf"] = {"skipped": "extras deadline"}
+
+    # ---- int8 vs f32 inference (wp-bigdl.md:192-196 headline claim) ----
+    if _extras_budget_left("int8_inference", 400):
+        try:
+            extras["int8_inference"] = _bench_int8(jax, jnp, np, on_tpu)
+        except Exception as e:
+            extras["int8_inference"] = {"error": f"{type(e).__name__}: {e}"}
+            _log(f"int8 bench failed: {e}")
+    else:
+        extras["int8_inference"] = {"skipped": "extras deadline"}
 
     baseline = 100.0  # nominal target (no published reference number)
     print(json.dumps({
@@ -319,6 +352,66 @@ def _bench_ncf(jax, jnp, np, on_tpu: bool):
             "samples_per_sec": round(sps * batch, 0),
             "users": users, "items": items,
             "method": f"lax.scan x{n_steps} inside one jit"}
+
+
+def _bench_int8(jax, jnp, np, on_tpu: bool):
+    """VGG-16 inference, int8 vs f32, interleaved — the reference's
+    quantization headline is "up to 2x inference speedup, 4x model-size
+    reduction" (wp-bigdl.md:192-196) on SSD/VGG.  Iteration loop inside
+    one jit (lax.scan) per the tunnel-floor methodology."""
+    from analytics_zoo_tpu.models.image.classification import vgg16
+    from analytics_zoo_tpu.ops.quantize import (quantize_graph,
+                                                quantized_size_bytes)
+
+    batch = 32 if on_tpu else 2
+    size = 224 if on_tpu else 32
+    n_steps = 12 if on_tpu else 2
+    model = vgg16(input_shape=(size, size, 3), num_classes=1000)
+    graph = model.to_graph()
+    params, state = graph.init(jax.random.PRNGKey(0))
+    qgraph, qparams, qstate = quantize_graph(graph, params, state)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, size, size, 3)),
+                    dtype=jnp.float32)
+
+    def make_run(g, p, s):
+        def fwd(carry, _):
+            # chain the output back in so scan can't be elided
+            y, _ = g.apply(p, s, x + carry[..., None, None] * 0)
+            return y[..., :1], y[0, 0]
+        @jax.jit
+        def run():
+            carry, ys = jax.lax.scan(fwd, jnp.zeros((batch, 1)), None,
+                                     length=n_steps)
+            return ys[-1]
+        return run
+
+    runs = {"f32": make_run(graph, params, state),
+            "int8": make_run(qgraph, qparams, qstate)}
+    best = {}
+    for name, run in runs.items():
+        _ = float(run())  # compile + warm
+    for _ in range(3 if on_tpu else 1):
+        for name, run in runs.items():
+            t0 = time.time()
+            _ = float(run())
+            dt = (time.time() - t0) / n_steps
+            best[name] = min(best.get(name, 1e9), dt)
+    f32_ips = batch / best["f32"]
+    int8_ips = batch / best["int8"]
+    size_f32 = sum(int(np.prod(np.shape(l))) * 4
+                   for l in jax.tree_util.tree_leaves(params))
+    size_int8 = quantized_size_bytes(qparams)
+    out = {"f32_images_per_sec": round(f32_ips, 1),
+           "int8_images_per_sec": round(int8_ips, 1),
+           "speedup": round(int8_ips / f32_ips, 3),
+           "model_size_ratio": round(size_f32 / max(size_int8, 1), 2),
+           "batch": batch, "model": "vgg-16"}
+    _log(f"int8 inference: f32 {f32_ips:.0f} img/s, int8 {int8_ips:.0f} "
+         f"img/s ({out['speedup']}x), size ratio "
+         f"{out['model_size_ratio']}x")
+    return out
 
 
 def _bench_attention(jax, jnp, on_tpu: bool):

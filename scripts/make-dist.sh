@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Build the distributable wheel into dist/ (reference analog:
+# make-dist.sh producing the dist/ consumed by *-with-zoo.sh).
+# Offline-friendly: uses the already-installed setuptools, no build
+# isolation, no network.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+# clear stale build state too — a non-isolated setuptools build reuses
+# build/lib, which would ship since-deleted modules in the wheel
+rm -rf dist build ./*.egg-info
+pip wheel --no-deps --no-build-isolation -w dist .
+echo "dist/ contents:"
+ls -l dist/
+echo
+echo "install with:  pip install dist/analytics_zoo_tpu-*.whl"
+echo "then run:      zoo-tpu-submit --help"

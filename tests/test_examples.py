@@ -39,6 +39,12 @@ class TestExamples:
                           "--epochs", "2")
         assert "final train MAE" in out
 
+    def test_ring_attention_example(self):
+        out = run_example(
+            "examples/longcontext/ring_attention_example.py",
+            "--seq-len", "1024")
+        assert "ring attention OK: seq 1024 split 8 ways" in out
+
     def test_lenet_train_then_evaluate(self, tmp_path):
         ckpt = str(tmp_path / "ckpt")
         run_example("examples/lenet/train_lenet.py", "--epochs", "1",
