@@ -74,24 +74,29 @@ def expert_capacity(n_tokens: int, n_experts: int,
 
 def _route(x, gate_w, n_experts: int, capacity: int):
     """Top-1 routing -> (dispatch one-hot (T, E, C), combine weights
-    (T, E, C), aux load-balancing loss)."""
-    logits = x @ gate_w                            # (T, E)
+    (T, E, C), per-shard expert-load stats for the aux loss).
+
+    Queue positions are computed with an int32 cumsum regardless of
+    ``x.dtype`` — a bf16 cumsum is only exact to 256, after which
+    colliding capacity slots silently sum multiple tokens into one
+    expert row.  Only the final dispatch/combine tensors take x's dtype.
+    """
+    logits = (x @ gate_w).astype(jnp.float32)      # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)        # (T,)
-    expert_1h = jax.nn.one_hot(expert_idx, n_experts, dtype=x.dtype)
-    # position of each token within its expert's queue
-    pos_in_expert = (jnp.cumsum(expert_1h, axis=0) - 1.0) * expert_1h
-    keep = (pos_in_expert < capacity) * expert_1h  # (T, E) 0/1
-    pos = jnp.sum(pos_in_expert * keep, axis=-1).astype(jnp.int32)  # (T,)
-    pos_1h = jax.nn.one_hot(pos, capacity, dtype=x.dtype)
-    dispatch = keep[:, :, None] * pos_1h[:, None, :]      # (T, E, C)
-    gate_val = jnp.sum(probs * expert_1h, axis=-1)        # (T,)
-    combine = dispatch * gate_val[:, None, None]
-    # Switch load-balancing aux loss: E * sum_e f_e * p_e
-    f = jnp.mean(expert_1h, axis=0)
+    int_1h = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)
+    # position of each token within its expert's queue (exact int math)
+    pos_in_expert = (jnp.cumsum(int_1h, axis=0) - 1) * int_1h
+    keep = (pos_in_expert < capacity) * int_1h     # (T, E) 0/1
+    pos = jnp.sum(pos_in_expert * keep, axis=-1)   # (T,)
+    pos_1h = jax.nn.one_hot(pos, capacity, dtype=jnp.int32)
+    dispatch = (keep[:, :, None] * pos_1h[:, None, :]).astype(x.dtype)
+    gate_val = jnp.sum(probs * int_1h, axis=-1)    # (T,) f32
+    combine = dispatch * gate_val.astype(x.dtype)[:, None, None]
+    # Switch load-balancing stats: fraction routed / mean prob per expert
+    f = jnp.mean(int_1h.astype(jnp.float32), axis=0)
     p = jnp.mean(probs, axis=0)
-    aux = n_experts * jnp.sum(f * p)
-    return dispatch, combine, aux
+    return dispatch, combine, (f, p)
 
 
 def _apply_experts(blocks, w1, b1, w2, b2):
@@ -111,7 +116,8 @@ def switch_moe(x, params: MoEParams, capacity_factor: float = 1.25,
     n_experts = params.gate.shape[-1]
     c = capacity if capacity is not None else expert_capacity(
         t, n_experts, capacity_factor)
-    dispatch, combine, aux = _route(x, params.gate, n_experts, c)
+    dispatch, combine, (f, p) = _route(x, params.gate, n_experts, c)
+    aux = n_experts * jnp.sum(f * p)
     blocks = jnp.einsum("tec,td->ecd", dispatch, x)       # (E, C, d)
     outs = _apply_experts(blocks, params.w1, params.b1, params.w2,
                           params.b2)
@@ -125,7 +131,14 @@ def _moe_local(x, params: MoEParams, n_experts: int, capacity: int,
     n = lax.axis_size(axis_name)
     e_local = n_experts // n
     # routing needs ALL experts' gate columns — gate is replicated
-    dispatch, combine, aux = _route(x, params.gate, n_experts, capacity)
+    dispatch, combine, (f, p) = _route(x, params.gate, n_experts,
+                                       capacity)
+    # aux loss over GLOBAL routing stats (pmean f and p BEFORE the
+    # product) so sharded and single-device training see the same
+    # gate gradients even when routing is uneven across token shards
+    f = lax.pmean(f, axis_name)
+    p = lax.pmean(p, axis_name)
+    aux = n_experts * jnp.sum(f * p)
     blocks = jnp.einsum("tec,td->ecd", dispatch, x)       # (E, C, d)
     # (E, C, d) -> (n, E_local, C, d): send each expert block to its
     # owner; receive every device's blocks for MY experts
@@ -147,7 +160,6 @@ def _moe_local(x, params: MoEParams, n_experts: int, capacity: int,
     # axis 0 = expert-OWNER device; global expert id = owner*E_local + e
     outs = outs.reshape(n_experts, capacity, d)
     y = jnp.einsum("tec,ecd->td", combine, outs)
-    aux = lax.pmean(aux, axis_name)
     return y, aux
 
 

@@ -19,7 +19,7 @@ Constraints (the standard homogeneous-pipeline shape):
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -69,7 +69,7 @@ def _pipeline_local(x, params, stage_fn: Callable, n_micro: int,
 
 def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh,
                    axis_name: str = "pipe",
-                   n_microbatches: int = None):
+                   n_microbatches: Optional[int] = None):
     """Run ``x`` through ``n_stages`` copies of ``stage_fn`` pipelined
     over the mesh's ``axis_name`` axis.
 
@@ -86,7 +86,9 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, mesh: Mesh,
             f"stage_params leaves need leading axis {n_stages} "
             f"(the {axis_name!r} mesh axis); got "
             f"{leaves[0].shape if leaves else 'no leaves'}")
-    n_micro = n_microbatches or n_stages
+    n_micro = n_stages if n_microbatches is None else n_microbatches
+    if n_micro < 1:
+        raise ValueError(f"n_microbatches must be >= 1, got {n_micro}")
     if x.shape[0] % n_micro:
         raise ValueError(
             f"batch ({x.shape[0]}) is not divisible by n_microbatches "

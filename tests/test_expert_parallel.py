@@ -112,3 +112,34 @@ def test_expert_capacity_rounding():
     assert expert_capacity(64, 8, 1.0) == 8
     assert expert_capacity(64, 8, 1.25) == 10
     assert expert_capacity(3, 8, 1.0) == 1
+
+
+def test_routing_exact_in_bf16_beyond_256_tokens():
+    """Regression: a bf16 cumsum is only exact to 256 — queue positions
+    must use int math or tokens silently share dispatch slots."""
+    rng = jax.random.PRNGKey(3)
+    d, n_exp, tokens = 4, 2, 2048
+    params = init_moe_params(rng, d, 8, n_exp, dtype=jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(4), (tokens, d),
+                          jnp.bfloat16)
+    from analytics_zoo_tpu.parallel.expert import _route
+    dispatch, _, _ = _route(x, params.gate, n_exp, capacity=tokens)
+    # every (expert, capacity) slot holds AT MOST one token
+    per_slot = np.asarray(dispatch, np.float32).sum(axis=0)
+    assert per_slot.max() <= 1.0, per_slot.max()
+    # and every token that routed is dispatched exactly once
+    per_token = np.asarray(dispatch, np.float32).sum(axis=(1, 2))
+    np.testing.assert_array_equal(per_token, np.ones(tokens))
+
+
+def test_sharded_aux_matches_single_device(setup):
+    """Regression: the sharded aux loss must use GLOBAL routing stats
+    (pmean before the f*p product), matching switch_moe exactly."""
+    params, x, n_exp = setup
+    mesh = create_mesh({"expert": 4, "data": 2})
+    _, aux_sharded = jax.jit(
+        lambda x, p: moe_sharded(x, p, mesh, capacity_factor=8.0))(
+            x, params)
+    _, aux_single = switch_moe(x, params, capacity=x.shape[0])
+    np.testing.assert_allclose(float(aux_sharded), float(aux_single),
+                               rtol=1e-5)
