@@ -39,6 +39,12 @@ class TestExamples:
                           "--epochs", "2")
         assert "final train MAE" in out
 
+    def test_spmd_blocks_example(self):
+        out = run_example("examples/parallelism/spmd_blocks.py",
+                          "--steps", "10")
+        assert "spmd blocks OK" in out
+        assert "moe sharded vs single-device" in out
+
     def test_ring_attention_example(self):
         out = run_example(
             "examples/longcontext/ring_attention_example.py",
