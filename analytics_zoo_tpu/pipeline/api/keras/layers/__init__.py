@@ -27,5 +27,6 @@ from .torch_style import (
     SoftShrink, HardTanh, RReLU, Exp, Log, Sqrt, Square, Negative, Identity,
     Power, Mul, CAdd, CMul, Scale, GaussianSampler, KerasLayerWrapper,
     Narrow, Select, Squeeze)
+from .moe import SwitchMoE
 from ..engine import Sequential, Model
 from .....core.graph import Input, InputLayer

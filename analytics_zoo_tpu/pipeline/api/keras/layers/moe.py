@@ -1,0 +1,78 @@
+"""SwitchMoE: mixture-of-experts as a Keras-API layer.
+
+Extension scope (no reference analog — SURVEY §2.10: the reference is
+data-parallel only): wraps the functional switch-MoE block
+(``analytics_zoo_tpu.parallel.expert``) in the layer contract so
+Sequential/Model users get an MoE FFN with one ``add``.  Inside a model
+it runs the single-device formulation; for explicit expert-sharded
+execution over a mesh use ``parallel.moe_sharded`` directly.
+
+Input (batch, seq, d_model) or (batch, d_model); output the same shape
+with a residual connection (so capacity-dropped tokens pass through
+unchanged).  The load-balancing aux loss (scaled by ``aux_weight``) is surfaced
+through the layer state under the reserved key ``aux_loss``, which
+``build_train_step`` sums into the training loss inside the gradient
+closure — the router receives the Switch balancing gradient with no
+user wiring.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.module import Layer, register_layer
+from .....parallel.expert import (MoEParams, expert_capacity,
+                                  init_moe_params, switch_moe)
+
+
+@register_layer
+class SwitchMoE(Layer):
+    """Switch-routed MoE FFN with residual: y = x + MoE(x)."""
+
+    stateful = True
+
+    def __init__(self, n_experts: int = 8, hidden_dim: int = None,
+                 capacity_factor: float = 1.25, aux_weight: float = 0.01,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.n_experts = int(n_experts)
+        self.hidden_dim = hidden_dim
+        self.capacity_factor = float(capacity_factor)
+        # the Switch paper's load-balancing coefficient; the trainer sums
+        # every layer's state["aux_loss"] into the training loss
+        self.aux_weight = float(aux_weight)
+
+    def _dims(self, input_shape):
+        d = input_shape[-1]
+        h = self.hidden_dim or 4 * d
+        return d, h
+
+    def init_params(self, rng, input_shape):
+        d, h = self._dims(input_shape)
+        p = init_moe_params(rng, d, h, self.n_experts)
+        return dict(p._asdict())
+
+    def init_state(self, input_shape):
+        return {"aux_loss": jnp.zeros(())}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        d = inputs.shape[-1]
+        flat = inputs.reshape(-1, d)
+        p = MoEParams(**{k: params[k]
+                         for k in MoEParams._fields})
+        cap = expert_capacity(flat.shape[0], self.n_experts,
+                              self.capacity_factor)
+        out, aux = switch_moe(flat, p, capacity=cap)
+        y = inputs + out.reshape(inputs.shape)
+        return y, {"aux_loss": self.aux_weight * aux}
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(n_experts=self.n_experts, hidden_dim=self.hidden_dim,
+                   capacity_factor=self.capacity_factor,
+                   aux_weight=self.aux_weight)
+        return cfg

@@ -90,6 +90,18 @@ def build_train_step(model, loss_fn, optimizer, compute_dtype=None,
     """
     cast = compute_dtype
 
+    def collect_aux(state) -> Any:
+        """Differentiable auxiliary penalties that layers surface in
+        their state under the reserved key ``aux_loss`` (e.g. SwitchMoE
+        router balancing, already scaled by the layer's aux_weight).
+        Summed into the training loss INSIDE the grad closure so the
+        penalty actually reaches the parameters."""
+        total = 0.0
+        for sub in state.values():
+            if isinstance(sub, dict) and "aux_loss" in sub:
+                total = total + sub["aux_loss"]
+        return total
+
     def train_step(params, model_state, opt_state, rng, x, y):
         def compute_loss(p):
             xin, p_in = x, p
@@ -102,7 +114,8 @@ def build_train_step(model, loss_fn, optimizer, compute_dtype=None,
                 p_in, model_state, xin, training=True, rng=rng)
             per_sample = loss_fn(y, y_pred.astype(jnp.float32)
                                  if cast is not None else y_pred)
-            return jnp.mean(per_sample), new_state
+            loss = jnp.mean(per_sample) + collect_aux(new_state)
+            return loss, new_state
 
         (loss, new_state), grads = jax.value_and_grad(
             compute_loss, has_aux=True)(params)

@@ -116,6 +116,8 @@ CASES = {
                      (3, 3, 3, 2), None),
     "SpaceToDepth2D": (lambda s: L.SpaceToDepth2D(2, input_shape=s),
                        (4, 4, 3), None),
+    "SwitchMoE": (lambda s: L.SwitchMoE(n_experts=4, hidden_dim=8,
+                                        input_shape=s), (6,), None),
     "ResizeBilinear": (
         lambda s: L.ResizeBilinear(output_height=6, output_width=7,
                                    input_shape=s), (4, 5, 2), None),
