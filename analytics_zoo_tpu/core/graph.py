@@ -268,6 +268,17 @@ class GraphModule(Layer):
             s = state.get(layer.name, {})
             out, s_new = layer.apply(p, s, ins, training=training, rng=r)
             if layer.stateful and s_new:
+                prev = new_state.get(layer.name)
+                if (prev is not None and prev is not s
+                        and isinstance(s_new, dict)
+                        and "aux_loss" in s_new and "aux_loss" in prev):
+                    # shared layer instance called at multiple nodes:
+                    # ACCUMULATE the differentiable penalty across calls
+                    # (last-write would silently drop earlier calls'
+                    # aux gradient, e.g. a shared SwitchMoE's balancing)
+                    s_new = {**s_new,
+                             "aux_loss": s_new["aux_loss"]
+                             + prev["aux_loss"]}
                 new_state[layer.name] = s_new
             values[v.node_id] = out
         outs = [values[v.node_id] for v in self.output_vars]

@@ -24,6 +24,7 @@ from .....core import initializers
 from .....core import shapes as shape_utils
 from .....core.module import Layer, register_layer
 from .. import activations
+from .. import regularizers
 
 _DN = {  # channels-last conv dimension numbers per spatial rank
     1: ("NWC", "WIO", "NWC"),
@@ -42,7 +43,7 @@ def _padding(border_mode: str, rank: int):
     raise ValueError(f"Unsupported border_mode {border_mode!r}")
 
 
-class _ConvND(Layer):
+class _ConvND(regularizers.RegularizedLayerMixin, Layer):
     """Shared machinery for 1/2/3-D convolutions."""
 
     rank: int = 2
@@ -50,6 +51,7 @@ class _ConvND(Layer):
     def __init__(self, nb_filter, kernel_size, init="glorot_uniform",
                  activation=None, border_mode="valid", subsample=1,
                  dilation=1, dim_ordering=None, bias=True,
+                 W_regularizer=None, b_regularizer=None,
                  input_shape=None, name=None):
         super().__init__(input_shape=input_shape, name=name)
         self.nb_filter = int(nb_filter)
@@ -65,6 +67,7 @@ class _ConvND(Layer):
         self.activation = activations.get(activation)
         self.bias = bias
         self.data_format = shape_utils.normalize_data_format(dim_ordering)
+        self._setup_regularizers(W_regularizer, b_regularizer)
 
     # -- layout helpers: everything internal is channels-last --
     def _to_cl(self, x):
@@ -117,7 +120,10 @@ class _ConvND(Layer):
             y = y + params["b"]
         if self.activation is not None:
             y = self.activation(y)
-        return self._from_cl(y)
+        y = self._from_cl(y)
+        if self.stateful:
+            return y, {"aux_loss": self._penalty(params)}
+        return y
 
     def compute_output_shape(self, input_shape):
         cl = self._cl_shape(input_shape)
@@ -140,7 +146,9 @@ class _ConvND(Layer):
                    border_mode=self.border_mode,
                    subsample=list(self.subsample),
                    dilation=list(self.dilation), bias=self.bias,
-                   dim_ordering=self.data_format)
+                   dim_ordering=self.data_format,
+                   W_regularizer=regularizers.to_config(self.W_regularizer),
+                   b_regularizer=regularizers.to_config(self.b_regularizer))
         return cfg
 
 

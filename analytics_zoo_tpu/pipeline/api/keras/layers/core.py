@@ -18,11 +18,12 @@ import jax.numpy as jnp
 from .....core import initializers
 from .....core import shapes as shape_utils
 from .....core.module import Layer, register_layer
+from .. import regularizers
 from .. import activations
 
 
 @register_layer
-class Dense(Layer):
+class Dense(regularizers.RegularizedLayerMixin, Layer):
     """Fully connected layer: ``y = act(x @ W + b)``.
 
     Reference: zoo/.../keras/layers/Dense.scala.  Weight layout is
@@ -40,8 +41,7 @@ class Dense(Layer):
         self.activation_name = activation if not callable(activation) else None
         self.activation = activations.get(activation)
         self.bias = bias
-        self.W_regularizer = W_regularizer
-        self.b_regularizer = b_regularizer
+        self._setup_regularizers(W_regularizer, b_regularizer)
 
     def init_params(self, rng, input_shape):
         in_dim = input_shape[-1]
@@ -58,6 +58,8 @@ class Dense(Layer):
             y = y + params["b"]
         if self.activation is not None:
             y = self.activation(y)
+        if self.stateful:
+            return y, {"aux_loss": self._penalty(params)}
         return y
 
     def compute_output_shape(self, input_shape):
@@ -66,7 +68,9 @@ class Dense(Layer):
     def get_config(self):
         cfg = super().get_config()
         cfg.update(output_dim=self.output_dim, init=self.init_name,
-                   activation=self.activation_name, bias=self.bias)
+                   activation=self.activation_name, bias=self.bias,
+                   W_regularizer=regularizers.to_config(self.W_regularizer),
+                   b_regularizer=regularizers.to_config(self.b_regularizer))
         return cfg
 
 

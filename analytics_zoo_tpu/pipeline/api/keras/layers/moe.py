@@ -18,7 +18,6 @@ user wiring.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from .....core.module import Layer, register_layer

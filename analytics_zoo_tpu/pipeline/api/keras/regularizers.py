@@ -1,0 +1,134 @@
+"""Weight regularizers (reference: BigDL L1/L2/L1L2Regularizer, consumed
+by Keras-1 layers' ``W_regularizer``/``b_regularizer`` args).
+
+A regularizer maps a weight tensor to a scalar penalty.  Regularized
+layers surface the summed penalty through their state under the
+reserved ``aux_loss`` key, which ``build_train_step`` folds into the
+training loss inside the gradient closure — the same machinery as
+SwitchMoE's balancing loss, so the penalty actually reaches the
+weights during fit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def __call__(self, w):
+        raise NotImplementedError
+
+    def get_config(self) -> dict:
+        return {"type": type(self).__name__, **self._rates()}
+
+    def _rates(self) -> dict:
+        return {}
+
+    def __repr__(self):
+        rates = ", ".join(f"{k}={v}" for k, v in self._rates().items())
+        return f"{type(self).__name__}({rates})"
+
+
+class L1(Regularizer):
+    """rate * sum(|w|) — reference L1Regularizer."""
+
+    def __init__(self, l1: float = 0.01):
+        self.l1 = float(l1)
+
+    def __call__(self, w):
+        return self.l1 * jnp.sum(jnp.abs(w))
+
+    def _rates(self):
+        return {"l1": self.l1}
+
+
+class L2(Regularizer):
+    """rate * sum(w^2) — reference L2Regularizer."""
+
+    def __init__(self, l2: float = 0.01):
+        self.l2 = float(l2)
+
+    def __call__(self, w):
+        return self.l2 * jnp.sum(jnp.square(w))
+
+    def _rates(self):
+        return {"l2": self.l2}
+
+
+class L1L2(Regularizer):
+    """Combined penalty — reference L1L2Regularizer."""
+
+    def __init__(self, l1: float = 0.01, l2: float = 0.01):
+        self.l1, self.l2 = float(l1), float(l2)
+
+    def __call__(self, w):
+        return (self.l1 * jnp.sum(jnp.abs(w))
+                + self.l2 * jnp.sum(jnp.square(w)))
+
+    def _rates(self):
+        return {"l1": self.l1, "l2": self.l2}
+
+
+# aliases matching the reference's BigDL class names
+L1Regularizer = L1
+L2Regularizer = L2
+L1L2Regularizer = L1L2
+
+
+def get(spec):
+    """Resolve None | Regularizer | "l1"/"l2" | config dict."""
+    if spec is None or isinstance(spec, Regularizer):
+        return spec
+    if isinstance(spec, str):
+        key = spec.lower()
+        if key == "l1":
+            return L1()
+        if key == "l2":
+            return L2()
+        if key in ("l1l2", "l1_l2"):
+            return L1L2()
+        raise ValueError(f"Unknown regularizer {spec!r}")
+    if isinstance(spec, dict):
+        cfg = dict(spec)
+        kind = cfg.pop("type")
+        return {"L1": L1, "L2": L2, "L1L2": L1L2}[kind](**cfg)
+    raise TypeError(f"Cannot interpret regularizer {spec!r}")
+
+
+def to_config(reg) -> dict:
+    return None if reg is None else reg.get_config()
+
+
+class RegularizedLayerMixin:
+    """Shared machinery for layers with W_regularizer/b_regularizer.
+
+    Call ``_setup_regularizers`` at the end of ``__init__``; the layer
+    becomes stateful when regularized and surfaces the penalty via
+    ``state["aux_loss"]`` (summed into the training loss by
+    ``build_train_step``).
+    """
+
+    def _setup_regularizers(self, W_regularizer, b_regularizer):
+        self.W_regularizer = get(W_regularizer)
+        self.b_regularizer = get(b_regularizer)
+        if self.W_regularizer is not None or self.b_regularizer is not None:
+            self.stateful = True
+
+    def init_state(self, input_shape):
+        if self.stateful:
+            return {"aux_loss": jnp.zeros(())}
+        return {}
+
+    def _penalty(self, params):
+        # f32 accumulation regardless of compute dtype — a bf16 sum over
+        # a large weight tensor drifts; mixed-precision practice applies
+        # regularizers at master-weight precision
+        pen = jnp.zeros(())
+        if self.W_regularizer is not None:
+            pen = pen + self.W_regularizer(
+                params["W"].astype(jnp.float32))
+        if self.b_regularizer is not None and getattr(self, "bias", False) \
+                and "b" in params:
+            pen = pen + self.b_regularizer(
+                params["b"].astype(jnp.float32))
+        return pen

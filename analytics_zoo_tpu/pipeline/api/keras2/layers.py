@@ -16,6 +16,7 @@ the registry entries from the keras-1 classes of the same name.
 from __future__ import annotations
 
 from ....core.module import Layer as _BaseLayer, register_layer
+from ..keras import regularizers as _reg
 from ..keras.layers import convolutional as k1conv
 from ..keras.layers import core as k1core
 from ..keras.layers import pooling as k1pool
@@ -48,7 +49,9 @@ class Dense(k1core.Dense):
     def get_config(self):
         cfg = _BaseLayer.get_config(self)
         cfg.update(units=self.output_dim, activation=self.activation_name,
-                   kernel_initializer=self.init_name, use_bias=self.bias)
+                   kernel_initializer=self.init_name, use_bias=self.bias,
+                   kernel_regularizer=_reg.to_config(self.W_regularizer),
+                   bias_regularizer=_reg.to_config(self.b_regularizer))
         return cfg
 
 
@@ -81,14 +84,18 @@ class Conv1D(k1conv.Convolution1D):
         super().__init__(nb_filter=filters, filter_length=kernel_size,
                          init=kernel_initializer, activation=activation,
                          border_mode=padding, subsample=strides,
-                         bias=use_bias, input_shape=input_shape, name=name)
+                         bias=use_bias, W_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer,
+                         input_shape=input_shape, name=name)
 
     def get_config(self):
         cfg = _BaseLayer.get_config(self)
         cfg.update(filters=self.nb_filter, kernel_size=self.kernel_size[0],
                    strides=self.subsample[0], padding=self.border_mode,
                    activation=self.activation_name, use_bias=self.bias,
-                   kernel_initializer=self.init_name)
+                   kernel_initializer=self.init_name,
+                   kernel_regularizer=_reg.to_config(self.W_regularizer),
+                   bias_regularizer=_reg.to_config(self.b_regularizer))
         return cfg
 
 
@@ -107,6 +114,8 @@ class Conv2D(k1conv.Convolution2D):
                          init=kernel_initializer, activation=activation,
                          border_mode=padding, subsample=strides,
                          dim_ordering=data_format, bias=use_bias,
+                         W_regularizer=kernel_regularizer,
+                         b_regularizer=bias_regularizer,
                          input_shape=input_shape, name=name)
 
     def get_config(self):
@@ -116,7 +125,9 @@ class Conv2D(k1conv.Convolution2D):
                    strides=list(self.subsample), padding=self.border_mode,
                    activation=self.activation_name, use_bias=self.bias,
                    kernel_initializer=self.init_name,
-                   data_format=self.data_format)
+                   data_format=self.data_format,
+                   kernel_regularizer=_reg.to_config(self.W_regularizer),
+                   bias_regularizer=_reg.to_config(self.b_regularizer))
         return cfg
 
 

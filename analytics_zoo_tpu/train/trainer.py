@@ -92,14 +92,19 @@ def build_train_step(model, loss_fn, optimizer, compute_dtype=None,
 
     def collect_aux(state) -> Any:
         """Differentiable auxiliary penalties that layers surface in
-        their state under the reserved key ``aux_loss`` (e.g. SwitchMoE
-        router balancing, already scaled by the layer's aux_weight).
-        Summed into the training loss INSIDE the grad closure so the
-        penalty actually reaches the parameters."""
+        their state under the reserved key ``aux_loss`` (SwitchMoE
+        router balancing, W_regularizer penalties — already scaled by
+        the layer).  Summed into the training loss INSIDE the grad
+        closure so the penalty actually reaches the parameters.
+        Traverses RECURSIVELY: nested models (a Sequential added into
+        another Sequential) nest their state one level per container."""
         total = 0.0
-        for sub in state.values():
-            if isinstance(sub, dict) and "aux_loss" in sub:
-                total = total + sub["aux_loss"]
+        if isinstance(state, dict):
+            for key, sub in state.items():
+                if key == "aux_loss":
+                    total = total + sub
+                else:
+                    total = total + collect_aux(sub)
         return total
 
     def train_step(params, model_state, opt_state, rng, x, y):
