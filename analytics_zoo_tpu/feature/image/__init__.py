@@ -6,4 +6,5 @@ from .transforms import (
     ImageChannelOrder, ImageBrightness, ImageHue, ImageSaturation,
     ImageContrast, ImageColorJitter, ImageExpand, ImageFiller, ImageHFlip,
     ImageRandomPreprocessing, ImageMatToFloats, ImageMatToTensor,
-    ImageSetToSample)
+    ImageSetToSample, ImageRandomAspectScale, ImagePreprocessing,
+    ImagePixelNormalize, ImageFeatureToTensor, RowToImageFeature)

@@ -539,3 +539,36 @@ class ImageSetToSample(Preprocessing):
     def get_config(self):
         return {"input_keys": list(self.input_keys),
                 "target_keys": list(self.target_keys)}
+
+
+@register_preprocessing
+class ImageRandomAspectScale(ImageProcessing):
+    """Aspect-preserving resize with the target short side chosen
+    randomly from ``scales`` per image (reference
+    imagePreprocessing.py:199 — detection train-time multi-scale)."""
+
+    def __init__(self, scales, scale_multiple_of: int = 1,
+                 max_size: int = 1000, seed: int = None):
+        self.scales = [int(s) for s in scales]
+        self.scale_multiple_of = int(scale_multiple_of)
+        self.max_size = int(max_size)
+        self.seed = seed
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, img):
+        scale = self.scales[self._rng.randint(len(self.scales))]
+        return ImageAspectScale(
+            scale, max_size=self.max_size,
+            scale_multiple_of=self.scale_multiple_of).transform(img)
+
+    def get_config(self):
+        return {"scales": list(self.scales),
+                "scale_multiple_of": self.scale_multiple_of,
+                "max_size": self.max_size, "seed": self.seed}
+
+
+# reference-name aliases (imagePreprocessing.py vocabulary)
+ImagePreprocessing = ImageProcessing
+ImagePixelNormalize = ImagePixelNormalizer
+ImageFeatureToTensor = ImageMatToTensor
+RowToImageFeature = ImageBytesToMat

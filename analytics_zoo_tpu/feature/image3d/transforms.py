@@ -157,3 +157,7 @@ class RandomCrop3D(ImageProcessing3D):
 
     def get_config(self):
         return {"patch_size": list(self.patch_size), "seed": self.seed}
+
+
+# reference-name alias (transformation.py ImagePreprocessing3D)
+ImagePreprocessing3D = ImageProcessing3D

@@ -6,7 +6,8 @@ from .recommendation import (Recommender, NeuralCF, WideAndDeep,
 from .recommendation_utils import (hash_bucket, categorical_from_vocab_list,
                                    get_boundaries, get_negative_samples,
                                    get_wide_tensor, get_deep_tensor,
-                                   row_to_feature, to_user_item_feature,
+                                   row_to_feature, row_to_sample,
+                                   to_user_item_feature,
                                    features_to_arrays)
 from .image.classification import ImageClassifier, resnet50, label_output
 from .image.detection import (ObjectDetector, ssd_vgg16, ssd_mobilenet,

@@ -168,3 +168,16 @@ def features_to_arrays(pairs: Sequence[UserItemFeature]):
     y = None if any(l is None for l in labels) \
         else np.asarray(labels, dtype=np.int32)
     return x, y
+
+
+def row_to_sample(row, column_info: ColumnFeatureInfo,
+                  model_type: str = "wide_n_deep"):
+    """Reference ``row_to_sample`` (utils.py:88): the BigDL Sample is a
+    feature+LABEL record, so this returns ``(feature, label)`` — unlike
+    ``row_to_feature``, which assembles features only."""
+    try:
+        label = row[column_info.label]
+    except (KeyError, IndexError):
+        label = None
+    return (row_to_feature(row, column_info, model_type),
+            None if label is None else int(label))

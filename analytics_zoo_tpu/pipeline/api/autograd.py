@@ -221,3 +221,7 @@ __all__ = [
     "contiguous", "mm", "batch_dot", "l2_normalize", "epsilon", "relu",
     "sigmoid", "tanh", "slice", "index_select",
 ]
+
+
+# reference-name alias (autograd.py LambdaLayer wraps Lambda)
+LambdaLayer = Lambda

@@ -152,3 +152,44 @@ def get(name):
         return _LOSSES[name]
     except KeyError:
         raise ValueError(f"Unknown loss {name!r}; known: {sorted(_LOSSES)}")
+
+
+# ---- class-style objectives (reference objectives.py:28-258 exposes
+# each loss as a LossFunction subclass; an INSTANCE is the callable) ----
+
+class LossFunction:
+    """Base of the class-style objective surface: ``MeanSquaredError()``
+    is interchangeable with ``"mse"`` / the bare function."""
+
+    _fn = None
+
+    def __call__(self, y_true, y_pred):
+        return type(self)._fn(y_true, y_pred)
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+def _loss_class(fn, class_name):
+    return type(class_name, (LossFunction,), {"_fn": staticmethod(fn)})
+
+
+SparseCategoricalCrossEntropy = _loss_class(
+    sparse_categorical_crossentropy, "SparseCategoricalCrossEntropy")
+CategoricalCrossEntropy = _loss_class(categorical_crossentropy,
+                                      "CategoricalCrossEntropy")
+BinaryCrossEntropy = _loss_class(binary_crossentropy, "BinaryCrossEntropy")
+MeanSquaredError = _loss_class(mean_squared_error, "MeanSquaredError")
+MeanAbsoluteError = _loss_class(mean_absolute_error, "MeanAbsoluteError")
+MeanAbsolutePercentageError = _loss_class(
+    mean_absolute_percentage_error, "MeanAbsolutePercentageError")
+MeanSquaredLogarithmicError = _loss_class(
+    mean_squared_logarithmic_error, "MeanSquaredLogarithmicError")
+Hinge = _loss_class(hinge, "Hinge")
+SquaredHinge = _loss_class(squared_hinge, "SquaredHinge")
+Poisson = _loss_class(poisson, "Poisson")
+KullbackLeiblerDivergence = _loss_class(kullback_leibler_divergence,
+                                        "KullbackLeiblerDivergence")
+CosineProximity = _loss_class(cosine_proximity, "CosineProximity")
+ClassNLLCriterion = _loss_class(class_nll, "ClassNLLCriterion")
+RankHinge = _loss_class(rank_hinge, "RankHinge")

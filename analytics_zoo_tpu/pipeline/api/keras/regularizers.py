@@ -76,8 +76,11 @@ L1L2Regularizer = L1L2
 
 
 def get(spec):
-    """Resolve None | Regularizer | "l1"/"l2" | config dict."""
-    if spec is None or isinstance(spec, Regularizer):
+    """Resolve None | Regularizer | custom callable | "l1"/"l2" |
+    config dict.  Plain callables (Keras-style ``lambda w: ...``) pass
+    through unchanged; they are applied but not serialized."""
+    if spec is None or isinstance(spec, Regularizer) or (
+            callable(spec) and not isinstance(spec, type)):
         return spec
     if isinstance(spec, str):
         key = spec.lower()
@@ -96,7 +99,13 @@ def get(spec):
 
 
 def to_config(reg) -> dict:
-    return None if reg is None else reg.get_config()
+    if reg is None:
+        return None
+    if not isinstance(reg, Regularizer):
+        # custom callable: applied at runtime, not serializable — the
+        # config round-trip drops it (documented in get())
+        return None
+    return reg.get_config()
 
 
 class RegularizedLayerMixin:

@@ -76,6 +76,25 @@ class TrainState:
         self.opt_state = tree["opt_state"]
 
 
+def _collect_aux(state) -> Any:
+    """Differentiable auxiliary penalties that layers surface in their
+    state under the reserved key ``aux_loss`` (SwitchMoE router
+    balancing, W_regularizer penalties — already scaled by the layer).
+    Training sums them into the loss INSIDE the grad closure so the
+    penalty actually reaches the parameters; evaluate includes them so
+    train and validation losses stay comparable (Keras semantics).
+    Traverses RECURSIVELY: nested models (a Sequential added into
+    another Sequential) nest their state one level per container."""
+    total = 0.0
+    if isinstance(state, dict):
+        for key, sub in state.items():
+            if key == "aux_loss":
+                total = total + sub
+            else:
+                total = total + _collect_aux(sub)
+    return total
+
+
 def build_train_step(model, loss_fn, optimizer, compute_dtype=None,
                      jit: bool = True, donate: bool = True):
     """THE training iteration: grad → (XLA-inserted psum when the batch is
@@ -89,23 +108,7 @@ def build_train_step(model, loss_fn, optimizer, compute_dtype=None,
             -> (params, model_state, opt_state, loss)
     """
     cast = compute_dtype
-
-    def collect_aux(state) -> Any:
-        """Differentiable auxiliary penalties that layers surface in
-        their state under the reserved key ``aux_loss`` (SwitchMoE
-        router balancing, W_regularizer penalties — already scaled by
-        the layer).  Summed into the training loss INSIDE the grad
-        closure so the penalty actually reaches the parameters.
-        Traverses RECURSIVELY: nested models (a Sequential added into
-        another Sequential) nest their state one level per container."""
-        total = 0.0
-        if isinstance(state, dict):
-            for key, sub in state.items():
-                if key == "aux_loss":
-                    total = total + sub
-                else:
-                    total = total + collect_aux(sub)
-        return total
+    collect_aux = _collect_aux
 
     def train_step(params, model_state, opt_state, rng, x, y):
         def compute_loss(p):
@@ -241,11 +244,15 @@ class Trainer:
         loss_fn = self.loss_fn
 
         def eval_step(params, model_state, accs, loss_acc, x, y, mask):
-            y_pred, _ = model.apply(params, model_state, x, training=False)
+            y_pred, eval_state = model.apply(params, model_state, x,
+                                             training=False)
             new_accs = [m.update(a, y, y_pred, mask)
                         for m, a in zip(metrics, accs)]
             if loss_fn is not None:
-                per_sample = loss_fn(y, y_pred)
+                # include auxiliary penalties (regularizers / MoE aux)
+                # per sample so the reported evaluate loss is comparable
+                # with the training loss (Keras includes them too)
+                per_sample = loss_fn(y, y_pred) + _collect_aux(eval_state)
                 w = mask.reshape(-1).astype(jnp.float32)
                 loss_acc = {"sum": loss_acc["sum"]
                             + jnp.sum(per_sample * w),

@@ -1,0 +1,134 @@
+"""Reference-vocabulary parity: class-style objectives and the
+imagePreprocessing/autograd/recommendation alias names a migrating user
+will import (docs/migration.md)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import analytics_zoo_tpu as zoo
+
+
+def test_class_style_objectives_match_names():
+    from analytics_zoo_tpu.pipeline.api.keras.objectives import (
+        BinaryCrossEntropy, ClassNLLCriterion, CosineProximity, Hinge,
+        KullbackLeiblerDivergence, LossFunction, MeanAbsoluteError,
+        MeanAbsolutePercentageError, MeanSquaredError,
+        MeanSquaredLogarithmicError, Poisson,
+        SparseCategoricalCrossEntropy, SquaredHinge, get)
+    pairs = [
+        (MeanSquaredError, "mse"), (MeanAbsoluteError, "mae"),
+        (MeanAbsolutePercentageError, "mape"),
+        (MeanSquaredLogarithmicError, "msle"),
+        (BinaryCrossEntropy, "binary_crossentropy"),
+        (Hinge, "hinge"), (SquaredHinge, "squared_hinge"),
+        (Poisson, "poisson"),
+        (KullbackLeiblerDivergence, "kld"),
+        (CosineProximity, "cosine_proximity"),
+    ]
+    y = jnp.asarray([[0.2, 0.8], [0.6, 0.4]])
+    p = jnp.asarray([[0.3, 0.7], [0.5, 0.5]])
+    for cls, name in pairs:
+        inst = cls()
+        assert issubclass(cls, LossFunction)
+        np.testing.assert_allclose(np.asarray(inst(y, p)),
+                                   np.asarray(get(name)(y, p)),
+                                   rtol=1e-6, err_msg=name)
+    # integer-label forms
+    labels = jnp.asarray([0, 1])
+    np.testing.assert_allclose(
+        np.asarray(SparseCategoricalCrossEntropy()(labels, p)),
+        np.asarray(get("sparse_categorical_crossentropy")(labels, p)))
+    logp = jnp.log(p)
+    np.testing.assert_allclose(
+        np.asarray(ClassNLLCriterion()(labels, logp)),
+        np.asarray(get("class_nll")(labels, logp)))
+
+
+def test_class_objective_in_compile():
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.objectives import (
+        MeanSquaredError)
+    zoo.init_nncontext()
+    m = Sequential()
+    m.add(Dense(3, input_shape=(4,)))
+    m.compile(optimizer="sgd", loss=MeanSquaredError())
+    h = m.fit(np.zeros((8, 4), np.float32), np.zeros((8, 3), np.float32),
+              batch_size=8, nb_epoch=1)
+    assert np.isfinite(h["loss"][-1])
+
+
+def test_image_preprocessing_aliases():
+    from analytics_zoo_tpu.feature.image import (
+        ImageFeatureToTensor, ImagePixelNormalize, ImagePreprocessing,
+        ImageProcessing, ImageRandomAspectScale, RowToImageFeature)
+    assert ImagePreprocessing is ImageProcessing
+    t = ImageRandomAspectScale([200, 300], max_size=400, seed=0)
+    img = np.random.RandomState(0).randint(
+        0, 255, (100, 150, 3)).astype(np.float32)
+    picked = set()
+    for _ in range(16):
+        out = t({"image": img.copy()})["image"]
+        picked.add(min(out.shape[:2]))
+    # both scales get sampled; aspect ratio preserved
+    assert len(picked) == 2
+    for s in picked:
+        assert 190 <= s <= 310
+
+
+def test_misc_aliases_resolve():
+    from analytics_zoo_tpu.feature.image3d import ImagePreprocessing3D
+    from analytics_zoo_tpu.models import (ColumnFeatureInfo,
+                                          row_to_feature, row_to_sample)
+    from analytics_zoo_tpu.pipeline.api.autograd import (Lambda,
+                                                         LambdaLayer)
+    from analytics_zoo_tpu.feature.image import DistributedImageSet
+    from analytics_zoo_tpu.pipeline.estimator.nn_estimator import (
+        NNImageReader)
+    assert LambdaLayer is Lambda
+    # row_to_sample returns the reference's (feature, LABEL) record
+    ci = ColumnFeatureInfo(embed_cols=["userId"], embed_in_dims=[9],
+                           embed_out_dims=[4], label="label")
+    row = {"userId": 3, "itemId": 5, "label": 2}
+    feat, label = row_to_sample(row, ci, model_type="deep")
+    assert label == 2
+    np.testing.assert_array_equal(feat[0],
+                                  row_to_feature(row, ci, "deep")[0])
+
+
+def test_custom_callable_regularizer_accepted():
+    """Regression: Keras-style callable regularizers must pass through
+    (previously accepted-and-ignored; must not crash now)."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    zoo.init_nncontext()
+    m = Sequential()
+    m.add(Dense(3, W_regularizer=lambda w: 0.5 * jnp.sum(w ** 2),
+                input_shape=(4,), name="d"))
+    m.compile(optimizer={"name": "sgd", "lr": 0.0}, loss="mse")
+    x = np.zeros((8, 4), np.float32)
+    h = m.fit(x, np.zeros((8, 3), np.float32), batch_size=8, nb_epoch=1)
+    import jax as _jax
+    w = m.trainer.state.params["d"]["W"]
+    assert h["loss"][-1] == pytest.approx(0.5 * float(jnp.sum(w ** 2)),
+                                          rel=1e-4)
+
+
+def test_evaluate_loss_includes_penalty():
+    """Regression: evaluate loss must include regularizer penalties so
+    train/val losses are comparable (Keras semantics)."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.regularizers import L2
+    zoo.init_nncontext()
+    rs = np.random.RandomState(0)
+    x = rs.rand(32, 4).astype(np.float32)
+    y = rs.rand(32, 3).astype(np.float32)
+    m = Sequential()
+    m.add(Dense(3, W_regularizer=L2(0.5), input_shape=(4,), name="d"))
+    m.compile(optimizer={"name": "sgd", "lr": 0.0}, loss="mse")
+    h = m.fit(x, y, batch_size=32, nb_epoch=1)
+    res = m.evaluate(x, y, batch_size=32)
+    np.testing.assert_allclose(res["loss"], h["loss"][-1], rtol=1e-5)
