@@ -410,6 +410,10 @@ def _bench_int8(jax, jnp, np, on_tpu: bool):
            "speedup": round(int8_ips / f32_ips, 3),
            "model_size_ratio": round(size_f32 / max(size_int8, 1), 2),
            "batch": batch, "model": "vgg-16"}
+    if not on_tpu:
+        out["note"] = ("CPU fallback: XLA:CPU has no accelerated int8 "
+                       "conv path, so speedup here reflects the host, "
+                       "not the int8 design — measure on TPU")
     _log(f"int8 inference: f32 {f32_ips:.0f} img/s, int8 {int8_ips:.0f} "
          f"img/s ({out['speedup']}x), size ratio "
          f"{out['model_size_ratio']}x")
