@@ -20,20 +20,26 @@ import jax.numpy as jnp
 
 from .....core import initializers
 from .....core.module import Layer, register_layer
+from .. import regularizers
 
 
 @register_layer
-class Embedding(Layer):
-    """Trainable lookup table (reference Embedding.scala)."""
+class Embedding(regularizers.RegularizedLayerMixin, Layer):
+    """Trainable lookup table (reference Embedding.scala, incl. its
+    wRegularizer arg)."""
+
+    _reg_w_key = "embeddings"
 
     def __init__(self, input_dim, output_dim, init="uniform",
-                 input_length=None, input_shape=None, name=None):
+                 input_length=None, W_regularizer=None, input_shape=None,
+                 name=None):
         if input_length is not None and input_shape is None:
             input_shape = (input_length,)
         super().__init__(input_shape=input_shape, name=name)
         self.input_dim = int(input_dim)
         self.output_dim = int(output_dim)
         self.init_name = init
+        self._setup_regularizers(W_regularizer, None)
 
     def init_params(self, rng, input_shape):
         return {"embeddings": initializers.get(self.init_name)(
@@ -41,7 +47,10 @@ class Embedding(Layer):
 
     def call(self, params, state, inputs, training=False, rng=None):
         idx = inputs.astype(jnp.int32)
-        return jnp.take(params["embeddings"], idx, axis=0)
+        y = jnp.take(params["embeddings"], idx, axis=0)
+        if self.stateful:
+            return y, {"aux_loss": self._penalty(params)}
+        return y
 
     def compute_output_shape(self, input_shape):
         return tuple(input_shape) + (self.output_dim,)
@@ -49,7 +58,9 @@ class Embedding(Layer):
     def get_config(self):
         cfg = super().get_config()
         cfg.update(input_dim=self.input_dim, output_dim=self.output_dim,
-                   init=self.init_name)
+                   init=self.init_name,
+                   W_regularizer=regularizers.to_config(
+                       self.W_regularizer))
         return cfg
 
 

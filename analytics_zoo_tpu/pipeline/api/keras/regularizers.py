@@ -128,6 +128,9 @@ class RegularizedLayerMixin:
             return {"aux_loss": jnp.zeros(())}
         return {}
 
+    #: params key the W regularizer applies to (Embedding overrides)
+    _reg_w_key = "W"
+
     def _penalty(self, params):
         # f32 accumulation regardless of compute dtype — a bf16 sum over
         # a large weight tensor drifts; mixed-precision practice applies
@@ -135,7 +138,7 @@ class RegularizedLayerMixin:
         pen = jnp.zeros(())
         if self.W_regularizer is not None:
             pen = pen + self.W_regularizer(
-                params["W"].astype(jnp.float32))
+                params[self._reg_w_key].astype(jnp.float32))
         if self.b_regularizer is not None and getattr(self, "bias", False) \
                 and "b" in params:
             pen = pen + self.b_regularizer(

@@ -150,3 +150,25 @@ def test_shared_stateful_layer_accumulates_aux():
     pen_once = float(L2(1.0)(params["shared"]["W"]))
     got = float(new_state["shared"]["aux_loss"])
     np.testing.assert_allclose(got, 2 * pen_once, rtol=1e-5)
+
+
+def test_embedding_regularizer():
+    """Reference Embedding.scala carries wRegularizer — the penalty must
+    flow for the lookup table too (key 'embeddings', not 'W')."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Embedding
+    zoo.init_nncontext()
+    m = Sequential()
+    m.add(Embedding(10, 4, W_regularizer=L2(0.5), input_shape=(3,),
+                    name="emb"))
+    m.add(Flatten())
+    m.add(Dense(1))
+    m.compile(optimizer={"name": "sgd", "lr": 0.0}, loss="mse")
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, 10, (16, 3)).astype(np.int32)
+    y = np.zeros((16, 1), np.float32)
+    h = m.fit(x, y, batch_size=16, nb_epoch=1)
+    emb = m.trainer.state.params["emb"]["embeddings"]
+    pen = float(L2(0.5)(emb))
+    # lr=0: loss = mse(0-pred) + penalty; penalty part must be present
+    assert h["loss"][-1] >= pen - 1e-5
+    assert pen > 0
