@@ -444,7 +444,21 @@ class ImageClassifier(QuantizedVariantMixin, ZooModel):
     def predict_image_set(self, image_set, configure=None):
         """predictImageSet parity (ImageModel.scala:45-69): preprocess →
         predict → postprocess → attach results.  ``configure`` defaults
-        to the model name's registry entry (ImageConfigure.parse)."""
+        to the model name's registry entry (ImageConfigure.parse).
+
+        .. warning:: When ``configure`` is omitted, images whose shape
+           already equals the model input are assumed *model-ready* and
+           skip registry preprocessing entirely — a raw, unnormalized
+           image that happens to be exactly ``input_shape`` (e.g.
+           224x224x3) would be fed in un-mean-subtracted and predict
+           garbage.  The shape test is a heuristic, not a proof of
+           preprocessing.  To force the canonical pipeline regardless of
+           shape, pass it explicitly::
+
+               configure=ImageConfigure.parse(model_name)
+
+           which bypasses the shape shortcut unconditionally.
+        """
         from .config import ImageConfigure
         model_shape = tuple(self.hyper["input_shape"])
         if configure is None:

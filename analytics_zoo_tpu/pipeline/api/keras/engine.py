@@ -62,7 +62,12 @@ class KerasNet(Layer):
         loss_fn = objectives_lib.get(loss)
         opt = optimizers_lib.get(optimizer, clip_norm=self._clip_norm,
                                  clip_value=self._clip_value)
-        metric_objs = [metrics_lib.get(m) for m in metrics]
+        # string metrics inherit the loss's label base, so e.g.
+        # loss=ClassNLLCriterion(zero_based_label=False) +
+        # metrics=["accuracy"] rebases the accuracy comparison too
+        zero_based = getattr(loss_fn, "zero_based_label", True)
+        metric_objs = [metrics_lib.get(m, zero_based_label=zero_based)
+                       for m in metrics]
         prev_state = (self.trainer.state if self.trainer is not None
                       else None)
         # weights survive the trainer swap only when they carry meaning:

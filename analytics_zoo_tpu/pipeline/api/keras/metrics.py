@@ -48,10 +48,18 @@ class Metric:
 
 
 class Accuracy(Metric):
-    """Classification accuracy; handles scalar/int labels (zero-based) and
-    one-hot labels, binary (sigmoid) and multiclass (softmax) outputs."""
+    """Classification accuracy; handles scalar/int labels and one-hot
+    labels, binary (sigmoid) and multiclass (softmax) outputs.
+
+    ``zero_based_label`` mirrors the reference's ``Accuracy.scala:30``
+    parameter: pass ``False`` when integer labels are 1-based (the BigDL
+    ClassNLLCriterion convention — e.g. ratings 1..5), so the argmax
+    comparison is rebased instead of being systematically shifted."""
 
     name = "accuracy"
+
+    def __init__(self, zero_based_label=True):
+        self.zero_based_label = zero_based_label
 
     def init(self):
         return {"correct": jnp.zeros(()), "total": jnp.zeros(())}
@@ -63,12 +71,18 @@ class Accuracy(Metric):
                 true = jnp.argmax(y_true, axis=-1)
             else:
                 true = jnp.squeeze(y_true).astype(jnp.int32)
+                if not self.zero_based_label:
+                    true = true - 1
                 true = true.reshape(pred.shape)
         else:
             pred = (jnp.squeeze(y_pred, -1) if y_pred.ndim > 1 else
                     y_pred) > 0.5
             true = (jnp.squeeze(y_true, -1) if y_true.ndim > 1 else
-                    y_true) > 0.5
+                    y_true)
+            if not self.zero_based_label:
+                # 1-based binary labels {1, 2} -> {0, 1} before threshold
+                true = true - 1
+            true = true > 0.5
         w = _sample_mask(mask, pred.shape[0] if pred.ndim else 1)
         w = w.reshape((-1,) + (1,) * (pred.ndim - 1))
         per_elem = w * jnp.ones(pred.shape, jnp.float32)
@@ -83,11 +97,16 @@ class Accuracy(Metric):
 class Top5Accuracy(Metric):
     name = "top5accuracy"
 
+    def __init__(self, zero_based_label=True):
+        self.zero_based_label = zero_based_label
+
     def init(self):
         return {"correct": jnp.zeros(()), "total": jnp.zeros(())}
 
     def update(self, acc, y_true, y_pred, mask=None):
         true = jnp.squeeze(y_true).astype(jnp.int32).reshape(-1)
+        if not self.zero_based_label:
+            true = true - 1
         w = _sample_mask(mask, true.shape[0])
         top5 = jnp.argsort(y_pred, axis=-1)[..., -5:].reshape(len(true), 5)
         correct = jnp.sum(jnp.any(top5 == true[:, None], axis=-1) * w)
@@ -156,6 +175,9 @@ class Loss(Metric):
     def update(self, acc, y_true, y_pred, mask=None):
         per_sample = self.loss_fn(y_true, y_pred)
         w = _sample_mask(mask, per_sample.shape[0])
+        # masked-out padded samples may be NaN (e.g. out-of-range label
+        # guards on zero-padding); NaN * 0 is NaN, so zero them first
+        per_sample = jnp.where(w > 0, per_sample, 0.0)
         return {"sum": acc["sum"] + jnp.sum(per_sample * w),
                 "total": acc["total"] + jnp.sum(w)}
 
@@ -164,23 +186,46 @@ class Loss(Metric):
 
 
 class MAE(Metric):
+    """Mean absolute error.
+
+    Against a multi-class head (trailing dim > 1), **integer** targets
+    one rank lower are compared class-index-wise (``|argmax(pred) -
+    label|`` — the reference NCF notebook's MAE-on-log-softmax usage);
+    **float** targets take the elementwise path (one target broadcast
+    against each output).  Class labels must therefore be integer-dtype:
+    ratings stored as float against a log-softmax head will compute
+    elementwise |log-prob − rating|, which is not a class-distance.
+    Cast labels with ``.astype(np.int32)`` for class-index MAE."""
+
     name = "mae"
+
+    def __init__(self, zero_based_label=True):
+        self.zero_based_label = zero_based_label
 
     def init(self):
         return {"sum": jnp.zeros(()), "total": jnp.zeros(())}
 
     def update(self, acc, y_true, y_pred, mask=None):
         if y_pred.ndim == y_true.ndim + 1:
-            if y_pred.shape[-1] > 1:
-                # class-distribution output vs integer label (the
+            if (y_pred.shape[-1] > 1
+                    and jnp.issubdtype(y_true.dtype, jnp.integer)):
+                # class-distribution output vs INTEGER label (the
                 # reference NCF notebook validates a 5-class log-softmax
-                # with MAE): compare the predicted class to the label
+                # with MAE): compare the predicted class to the label.
                 y_pred = jnp.argmax(y_pred, axis=-1).astype(jnp.float32)
+                if not self.zero_based_label:
+                    y_true = y_true - 1
                 y_true = y_true.astype(jnp.float32)
-            else:
+            elif y_pred.shape[-1] == 1:
                 # (N, 1) regression head vs (N,) target: align ranks so
                 # the subtraction doesn't broadcast to (N, N)
                 y_pred = y_pred.squeeze(-1)
+            else:
+                # FLOAT target one rank below a multi-output head: stay
+                # on the elementwise path — one target per sample,
+                # compared against each of the k outputs (not the
+                # class-index path, and not last-axis misalignment)
+                y_true = y_true[..., None]
         err = jnp.abs(y_true - y_pred)
         w = _sample_mask(mask, err.shape[0] if err.ndim else 1)
         w = w.reshape((-1,) + (1,) * (err.ndim - 1))
@@ -272,18 +317,26 @@ class NDCG(_RankingMetric):
                 "total": acc["total"] + jnp.sum(w)}
 
 
-def get(name):
+def get(name, zero_based_label=True):
+    """Resolve a metric name/instance.
+
+    ``zero_based_label`` seeds STRING-constructed label-consuming metrics
+    (accuracy/top5/mae) so that ``compile(loss=ClassNLLCriterion(
+    zero_based_label=False), metrics=["accuracy"])`` reports a correctly
+    rebased accuracy instead of a silently base-shifted one.  Metric
+    instances pass through untouched — an explicit instance's own flag
+    always wins."""
     if isinstance(name, Metric):
         return name
     key = str(name).lower()
     if key in ("accuracy", "acc"):
-        return Accuracy()
+        return Accuracy(zero_based_label=zero_based_label)
     if key in ("top5accuracy", "top5", "top5acc"):
-        return Top5Accuracy()
+        return Top5Accuracy(zero_based_label=zero_based_label)
     if key == "auc":
         return AUC()
     if key == "mae":
-        return MAE()
+        return MAE(zero_based_label=zero_based_label)
     if key in ("hitratio", "hit_ratio", "hitrate"):
         return HitRatio()
     if key == "ndcg":

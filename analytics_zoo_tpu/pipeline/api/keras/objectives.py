@@ -64,24 +64,49 @@ def sparse_categorical_crossentropy(y_true, y_pred):
         labels = labels[None]
     p = jnp.clip(y_pred, EPS, 1.0)
     logp = jnp.log(p)
-    return -jnp.take_along_axis(
-        logp, labels[..., None], axis=-1).squeeze(-1)
+    return _guarded_label_pick(logp, labels)
 
 
-def class_nll(y_true, y_pred):
-    """y_true int labels (zero-based), y_pred LOG-probabilities.
+def _guarded_label_pick(logp, labels):
+    """-logp[label] with a loud out-of-range guard.
+
+    ``take_along_axis`` clamps out-of-range indices silently, which turns
+    a label-base mistake (feeding 1-based ratings 1..5 to a zero-based
+    loss) into quietly shifted training.  Instead, any label outside
+    [0, n_classes) poisons that sample's loss with NaN, so the batch mean
+    — and the first logged training loss — is NaN immediately.
+    """
+    n_classes = logp.shape[-1]
+    valid = (labels >= 0) & (labels < n_classes)
+    safe = jnp.clip(labels, 0, n_classes - 1)
+    picked = -jnp.take_along_axis(
+        logp, safe[..., None], axis=-1).squeeze(-1)
+    return jnp.where(valid, picked, jnp.nan)
+
+
+def class_nll(y_true, y_pred, zero_based_label=True):
+    """y_true int labels, y_pred LOG-probabilities.
 
     Parity: BigDL ClassNLLCriterion paired with a LogSoftMax output —
     the reference's NeuralCF/WideAndDeep training criterion
     (apps/recommendation-ncf notebook, NeuralCF.scala log-softmax head).
     Use this, not sparse_categorical_crossentropy (which expects
     probabilities), for models whose final activation is log_softmax.
+
+    The reference's ClassNLLCriterion consumes **1-based** labels
+    (BigDL convention); this function defaults to zero-based (the JAX /
+    tf.keras convention).  Pass ``zero_based_label=False`` — or
+    construct ``ClassNLLCriterion(zero_based_label=False)`` — to feed
+    1-based labels (e.g. ratings 1..5) directly, matching the reference
+    metrics' parameter of the same name.  Out-of-range labels under
+    either convention produce NaN loss rather than silently clamping.
     """
     labels = jnp.squeeze(y_true).astype(jnp.int32)
     if labels.ndim == 0:
         labels = labels[None]
-    return -jnp.take_along_axis(
-        y_pred, labels[..., None], axis=-1).squeeze(-1)
+    if not zero_based_label:
+        labels = labels - 1
+    return _guarded_label_pick(y_pred, labels)
 
 
 def hinge(y_true, y_pred):
@@ -191,5 +216,21 @@ Poisson = _loss_class(poisson, "Poisson")
 KullbackLeiblerDivergence = _loss_class(kullback_leibler_divergence,
                                         "KullbackLeiblerDivergence")
 CosineProximity = _loss_class(cosine_proximity, "CosineProximity")
-ClassNLLCriterion = _loss_class(class_nll, "ClassNLLCriterion")
+class ClassNLLCriterion(LossFunction):
+    """Class-style ``class_nll``.  Unlike the other objectives this one
+    carries state: ``zero_based_label=False`` replicates the reference
+    ClassNLLCriterion's 1-based label convention exactly (BigDL
+    ClassNLLCriterion.scala consumes labels 1..nClasses)."""
+
+    _fn = staticmethod(class_nll)
+
+    def __init__(self, zero_based_label=True):
+        self.zero_based_label = zero_based_label
+
+    def __call__(self, y_true, y_pred):
+        return class_nll(y_true, y_pred,
+                         zero_based_label=self.zero_based_label)
+
+    def __repr__(self):
+        return f"ClassNLLCriterion(zero_based_label={self.zero_based_label})"
 RankHinge = _loss_class(rank_hinge, "RankHinge")

@@ -254,6 +254,11 @@ class Trainer:
                 # with the training loss (Keras includes them too)
                 per_sample = loss_fn(y, y_pred) + _collect_aux(eval_state)
                 w = mask.reshape(-1).astype(jnp.float32)
+                # neutralize masked-out padding BEFORE weighting: padded
+                # tail samples can legitimately be NaN (e.g. class_nll's
+                # out-of-range guard on zero-padded labels rebased by
+                # zero_based_label=False), and NaN * 0 is NaN
+                per_sample = jnp.where(w > 0, per_sample, 0.0)
                 loss_acc = {"sum": loss_acc["sum"]
                             + jnp.sum(per_sample * w),
                             "n": loss_acc["n"] + jnp.sum(w)}
@@ -507,12 +512,23 @@ class Trainer:
             eval_step = self._eval_step
         else:
             from ..pipeline.api.keras import metrics as metrics_lib
-            use_metrics = [metrics_lib.get(m) for m in metrics]
-            # cache override steps by metric identity so an epoch loop
-            # with the same valMethods doesn't re-jit the forward pass
-            key = tuple((type(m).__name__, m.name,
-                         getattr(m, "k", None), getattr(m, "neg_num", None))
-                        for m in use_metrics)
+            zero_based = getattr(self.loss_fn, "zero_based_label", True)
+            use_metrics = [metrics_lib.get(m, zero_based_label=zero_based)
+                           for m in metrics]
+            # cache override steps by the metrics' FULL config so an
+            # epoch loop with the same valMethods doesn't re-jit, while a
+            # custom Metric subclass differing in any constructor
+            # attribute (not just name/k/neg_num) gets its own step
+            def _metric_key(m):
+                # callables are keyed by OBJECT (identity compare, and
+                # the key tuple keeps them alive so ids can't be
+                # recycled); everything else by repr
+                cfg = tuple(sorted(
+                    (k, v if callable(v) else repr(v))
+                    for k, v in vars(m).items()))
+                return (type(m).__module__, type(m).__qualname__,
+                        m.name, cfg)
+            key = tuple(_metric_key(m) for m in use_metrics)
             if self._eval_step_overrides.get("key") != key:
                 self._eval_step_overrides = {
                     "key": key, "step": self._build_eval_step(use_metrics)}
