@@ -99,15 +99,26 @@ class Dataset:
     @classmethod
     def from_batch_iterable(cls, make_iter: Callable[[], Iterable],
                             size: Optional[int] = None,
-                            steps_per_epoch: Optional[int] = None
+                            steps_per_epoch: Optional[int] = None,
+                            shuffle_buffer: Optional[int] = 8192,
                             ) -> "StreamingDataset":
         """Stream from any zero-arg factory returning an iterator of
         (x, y) numpy batches (arbitrary chunk sizes — they are re-batched
-        to the requested batch size).  The factory cannot shuffle; fit's
-        ``shuffle=True`` logs a warning and replays the source order."""
+        to the requested batch size).
+
+        The factory itself cannot re-order the source, so fit's
+        ``shuffle=True`` shuffles through a **windowed buffer**:
+        ``shuffle_buffer`` rows (default 8192) are collected, permuted
+        with the per-epoch seed, and emitted; the sub-batch tail carries
+        into the next window.  Memory stays bounded at ~one window.  Set
+        ``shuffle_buffer=None`` to restore the old behavior (source
+        order replayed, one warning logged).  Rows move at most ~one
+        window from their source position — shuffle at the source too if
+        the stream is strongly ordered (e.g. sorted by label)."""
         ds = StreamingDataset(lambda shuffle, seed, epoch: make_iter(),
                               size=size, steps_hint=steps_per_epoch)
         ds._can_shuffle = False
+        ds._shuffle_buffer = shuffle_buffer
         return ds
 
     @property
@@ -239,6 +250,17 @@ def _batch_slice(batch, start, stop):
     return sl(batch[0]), sl(batch[1])
 
 
+def _batch_take(batch, idx):
+    """Row-permute an (x, y) batch tree by index array."""
+    def tk(u):
+        if u is None:
+            return None
+        if isinstance(u, (tuple, list)):
+            return tuple(np.asarray(ui)[idx] for ui in u)
+        return np.asarray(u)[idx]
+    return tk(batch[0]), tk(batch[1])
+
+
 class StreamingDataset(Dataset):
     """Batches stream from a re-iterable source — NOTHING is materialized
     beyond the current working window, so a folder larger than host RAM
@@ -290,23 +312,30 @@ class StreamingDataset(Dataset):
                                  steps_hint=self._steps_hint)
         child._maps = self._maps + [wrapped]
         child._can_shuffle = self._can_shuffle
+        child._shuffle_buffer = self._shuffle_buffer
         return child
 
     _can_shuffle = True
+    _shuffle_buffer: Optional[int] = None
     _warned_no_shuffle = False
 
     def batches(self, batch_size: int, shuffle: bool = False,
                 seed: int = 0, epoch: int = 0, drop_remainder: bool = True,
                 ) -> Iterator[Tuple[Any, Any]]:
-        if shuffle and not self._can_shuffle \
-                and not StreamingDataset._warned_no_shuffle:
-            StreamingDataset._warned_no_shuffle = True
-            import logging
-            logging.getLogger("analytics_zoo_tpu").warning(
-                "this stream source cannot shuffle — every epoch replays "
-                "the source order. Shuffle at the source (ImageLoader "
-                "shuffles; a from_batch_iterable factory cannot).")
-        src = self._factory(shuffle, seed, epoch)
+        if shuffle and not self._can_shuffle:
+            if self._shuffle_buffer:
+                yield from self._windowed_shuffle_batches(
+                    batch_size, seed, epoch, drop_remainder)
+                return
+            if not StreamingDataset._warned_no_shuffle:
+                StreamingDataset._warned_no_shuffle = True
+                import logging
+                logging.getLogger("analytics_zoo_tpu").warning(
+                    "this stream source cannot shuffle and has "
+                    "shuffle_buffer=None — every epoch replays the "
+                    "source order. Shuffle at the source or pass a "
+                    "shuffle_buffer to from_batch_iterable.")
+        src = self._ingest(self._factory(shuffle, seed, epoch))
         # pending chunks + running row count: one concatenate per EMITTED
         # batch (a grow-the-buffer concat per source chunk would copy the
         # whole window once per chunk — ~batch/chunk× write amplification
@@ -315,10 +344,6 @@ class StreamingDataset(Dataset):
         rows = 0
         count = 0
         for chunk in src:
-            if not (isinstance(chunk, tuple) and len(chunk) == 2):
-                chunk = (chunk, None)
-            for fn in self._maps:
-                chunk = fn(chunk)
             pending.append(chunk)
             rows += _batch_rows(chunk)
             while rows >= batch_size:
@@ -341,6 +366,65 @@ class StreamingDataset(Dataset):
                        else _batch_concat_all(pending))
         if self._size is None:
             self._size = count  # learned after one full pass
+
+    def _ingest(self, src) -> Iterator[Tuple[Any, Any]]:
+        """Normalize source chunks to (x, y) tuples and apply the lazy
+        map chain — the single ingest path shared by the ordered and
+        windowed-shuffle batch iterators."""
+        for chunk in src:
+            if not (isinstance(chunk, tuple) and len(chunk) == 2):
+                chunk = (chunk, None)
+            for fn in self._maps:
+                chunk = fn(chunk)
+            yield chunk
+
+    def _windowed_shuffle_batches(self, batch_size: int, seed: int,
+                                  epoch: int, drop_remainder: bool
+                                  ) -> Iterator[Tuple[Any, Any]]:
+        """Windowed-buffer shuffle for sources that cannot re-order
+        themselves: collect ``_shuffle_buffer`` rows, permute, emit full
+        batches, carry the sub-batch tail into the next window.  Bounded
+        memory (~one window); per-epoch determinism via seed+epoch."""
+        rng = np.random.default_rng(seed + epoch)
+        window_rows = max(int(self._shuffle_buffer), batch_size)
+        src = self._ingest(self._factory(False, seed, epoch))
+        pending: List[Tuple[Any, Any]] = []
+        rows = 0
+        count = 0
+
+        def drain(final):
+            nonlocal pending, rows, count
+            window = (pending[0] if len(pending) == 1
+                      else _batch_concat_all(pending))
+            n = _batch_rows(window)
+            perm = rng.permutation(n)
+            window = _batch_take(window, perm)
+            start = 0
+            while n - start >= batch_size:
+                yield _batch_slice(window, start, start + batch_size)
+                start += batch_size
+                count += batch_size
+            if start < n:
+                if final:
+                    count += n - start
+                    if not drop_remainder:
+                        yield _batch_slice(window, start, n)
+                    pending, rows = [], 0
+                else:
+                    pending = [_batch_slice(window, start, n)]
+                    rows = n - start
+            else:
+                pending, rows = [], 0
+
+        for chunk in src:
+            pending.append(chunk)
+            rows += _batch_rows(chunk)
+            if rows >= window_rows:
+                yield from drain(final=False)
+        if rows:
+            yield from drain(final=True)
+        if self._size is None:
+            self._size = count
 
     def steps_per_epoch(self, batch_size: int,
                         drop_remainder: bool = True) -> int:

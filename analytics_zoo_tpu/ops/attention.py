@@ -142,8 +142,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sk: int,
 
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 1024, scale: float = None,
-                    interpret: bool = False):
-    """Pallas TPU flash attention; same layout contract as the others.
+                    interpret: bool = False, layout: str = "bshd"):
+    """Pallas TPU flash attention.
 
     Default blocks (q 256 × k 1024) are tuned on a v5e: measured (scan-
     loop methodology, r3) 14.2 vs 12.3 TFLOP/s for the XLA blockwise
@@ -151,12 +151,36 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     128] — 1.50× at long sequence, and 1.64× over
     jax.experimental.pallas.ops.tpu.flash_attention at the 2048 shape.
 
+    ``layout`` (VERDICT r3 #8 — the transpose tax):
+
+    - ``"bshd"`` (default, the shared layout contract): q/k/v are
+      (batch, seq, heads, head_dim).  The kernel's grid wants heads
+      adjacent to batch, so each array is TRANSPOSED to (b, h, s, d) —
+      a materialized copy, ~4 × b·s·h·d·2 bytes of HBM traffic per call
+      at bf16 (~64 MB at [4, 2048, 8, 128]).  A 4-D BlockSpec over the
+      raw (b, s, h, d) layout cannot lower: the block's minor-two dims
+      must be (sublane=s, lane=d), but h sits between them, so any
+      (block_q, 1, d) tile puts a size-1 h in the sublane slot
+      (captured analysis, PERF_NOTES r3/r4).
+    - ``"bhsd"``: q/k/v arrive (batch, heads, seq, head_dim).  Folding
+      to the kernel's (b·h, s, d) is a pure reshape of two contiguous
+      major axes — NO copy.  Transformer stacks should project straight
+      into this layout (``einsum("bse,ehd->bhsd", x, W)``) so XLA folds
+      the layout into the projection matmul's output and the transpose
+      tax disappears end-to-end.
+
     ``interpret=True`` runs the kernel in the pallas interpreter (CPU
     testing — SURVEY §4's "local device = cluster" trick applied to
     kernels).
     """
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+    if layout == "bshd":
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
+    elif layout == "bhsd":
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+    else:
+        raise ValueError(f"layout must be 'bshd' or 'bhsd', got {layout!r}")
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     # clamp to the sequence, then fall back to the largest divisor so any
     # seq length that has a usable block works with the tuned defaults
@@ -171,11 +195,17 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
         raise ValueError(
             f"seq lengths ({sq}, {sk}) have no usable block divisor — "
             "use blockwise/naive attention for prime-ish lengths")
-    # fold batch and heads into the grid's first axis ((b, s, h, d) with
-    # h second-to-last cannot tile on TPU — sublane dim must be s)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    if layout == "bshd":
+        # fold batch and heads into the grid's first axis — a materialized
+        # transpose (see docstring; pass layout="bhsd" to avoid it)
+        qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+        kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+        vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    else:
+        # contiguous major-axis fold: free
+        qf = q.reshape(b * h, sq, d)
+        kf = k.reshape(b * h, sk, d)
+        vf = v.reshape(b * h, sk, d)
 
     kernel = functools.partial(_flash_kernel, block_k=block_k, sk=sk,
                                causal=causal, sq=sq, scale=scale,
@@ -201,7 +231,9 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
         interpret=interpret,
         **kwargs,
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    if layout == "bshd":
+        return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, sq, d)
 
 
 def _largest_divisor(n: int, cap: int) -> int:

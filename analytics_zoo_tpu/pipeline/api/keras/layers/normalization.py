@@ -45,32 +45,30 @@ class BatchNormalization(Layer):
                 "moving_var": jnp.ones((n,))}
 
     def apply(self, params, state, inputs, training=False, rng=None):
+        from .....ops.batchnorm import (batch_norm_train,
+                                        batch_norm_inference)
         ndim = inputs.ndim
         ch_axis = self._channel_axis(ndim) % ndim
-        reduce_axes = tuple(i for i in range(ndim) if i != ch_axis)
-        bshape = [1] * ndim
-        bshape[ch_axis] = inputs.shape[ch_axis]
 
         if training:
-            # statistics in f32 regardless of compute dtype (bf16 batch
-            # stats lose too much precision), normalize in compute dtype
-            x32 = inputs.astype(jnp.float32)
-            mean = jnp.mean(x32, axis=reduce_axes)
-            var = jnp.var(x32, axis=reduce_axes)
+            # restructured train-mode core (ops/batchnorm.py): one-pass
+            # fused statistics + closed-form custom VJP — statistics
+            # accumulate in f32 regardless of compute dtype, and the
+            # moving-stat update is stop-gradient (BigDL running stats)
+            out, mean, var = batch_norm_train(
+                inputs, params["gamma"], params["beta"],
+                self.epsilon, ch_axis)
             m = self.momentum
             new_state = {
                 "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
                 "moving_var": m * state["moving_var"] + (1 - m) * var,
             }
         else:
-            mean, var = state["moving_mean"], state["moving_var"]
+            out = batch_norm_inference(
+                inputs, params["gamma"], params["beta"],
+                state["moving_mean"], state["moving_var"],
+                self.epsilon, ch_axis)
             new_state = state
-
-        dt = inputs.dtype
-        inv = params["gamma"].astype(dt).reshape(bshape) * (
-            1.0 / jnp.sqrt(var.astype(dt).reshape(bshape) + self.epsilon))
-        out = (inputs - mean.astype(dt).reshape(bshape)) * inv \
-            + params["beta"].astype(dt).reshape(bshape)
         return out, new_state
 
     def call(self, params, state, inputs, training=False, rng=None):

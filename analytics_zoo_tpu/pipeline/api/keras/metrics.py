@@ -224,7 +224,16 @@ class MAE(Metric):
                 # FLOAT target one rank below a multi-output head: stay
                 # on the elementwise path — one target per sample,
                 # compared against each of the k outputs (not the
-                # class-index path, and not last-axis misalignment)
+                # class-index path, and not last-axis misalignment).
+                # Dtypes are static, so this warning fires at TRACE
+                # time — a float-stored class-label vector (ratings as
+                # float32) silently changing semantics is the trap.
+                import warnings
+                warnings.warn(
+                    "MAE against a multi-output head with FLOAT targets "
+                    "uses elementwise error; if the targets are class "
+                    "labels (e.g. ratings), cast them to an integer "
+                    "dtype for class-index MAE.", stacklevel=2)
                 y_true = y_true[..., None]
         err = jnp.abs(y_true - y_pred)
         w = _sample_mask(mask, err.shape[0] if err.ndim else 1)

@@ -172,7 +172,12 @@ class NNEstimator(_Params):
                  else self.model)
         metric_objs = []
         if self.validation:
-            metric_objs = [metrics_lib.get(m) for m in self.validation[2]]
+            # string-built metrics inherit the criterion's label base
+            # (same contract as KerasNet.compile / Trainer.evaluate)
+            zero_based = getattr(loss_fn, "zero_based_label", True)
+            metric_objs = [
+                metrics_lib.get(m, zero_based_label=zero_based)
+                for m in self.validation[2]]
         trainer = Trainer(graph, loss_fn, opt, metrics=metric_objs,
                           mesh=self.mesh)
         if self.tensorboard:

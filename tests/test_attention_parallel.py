@@ -42,6 +42,22 @@ def test_flash_kernel_matches_naive(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bhsd_layout_matches_bshd(causal):
+    """VERDICT r3 #8: layout='bhsd' skips the materialized transposes;
+    results must be identical to the default layout."""
+    q, k, v = qkv(b=2, s=128, h=2, d=32)
+    ref = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                          interpret=True)
+    qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    out = flash_attention(qt, kt, vt, causal=causal, block_q=32,
+                          block_k=32, interpret=True, layout="bhsd")
+    np.testing.assert_allclose(np.asarray(out.transpose(0, 2, 1, 3)),
+                               np.asarray(ref), rtol=1e-6, atol=1e-6)
+    with pytest.raises(ValueError, match="layout"):
+        flash_attention(q, k, v, layout="sbhd", interpret=True)
+
+
 def test_attention_dispatch_and_validation():
     q, k, v = qkv(s=32)
     out = attention(q, k, v, implementation="blockwise")

@@ -209,3 +209,31 @@ def test_predict_without_compile():
     m = linear_model(2)
     out = m.predict(np.zeros((4, 4), np.float32), batch_size=4)
     assert out.shape == (4, 2)
+
+
+def test_nnestimator_validation_inherits_label_base():
+    """Code-review r4: NNEstimator validation metrics built from strings
+    must inherit the criterion's zero_based_label, like compile()."""
+    from analytics_zoo_tpu.pipeline.api.keras.objectives import (
+        ClassNLLCriterion)
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Activation
+    zoo.init_nncontext()
+    rng = np.random.default_rng(11)
+    n = 96
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y1 = (np.argmax(x[:, :3], axis=1) + 1).astype(np.int32)  # 1-based 1..3
+    df = pd.DataFrame({"features": [r.tolist() for r in x],
+                       "label": y1.tolist()})
+    m = Sequential()
+    m.add(Dense(3, input_shape=(4,)))
+    m.add(Activation("log_softmax"))
+    est = (NNEstimator(m, ClassNLLCriterion(zero_based_label=False),
+                       feature_preprocessing=SeqToTensor((4,)))
+           .set_batch_size(32).set_max_epoch(8)
+           .set_learning_rate(0.05).set_optim_method("adam"))
+    est.set_validation(EveryEpoch(), df, ["accuracy"], 32)
+    # structural check: the string-built metric carries the inherited flag
+    metric = est._build_trainer().metrics[0]
+    assert metric.zero_based_label is False
+    # and the whole fit+validate flow runs finite on 1-based labels
+    est.fit(df)

@@ -38,6 +38,58 @@ def test_rebatching_preserves_order_and_sizes():
     assert [len(b[0]) for b in ds2.batches(4)] == [4, 4]
 
 
+def test_windowed_shuffle_randomizes_order():
+    """VERDICT r3 #10: fit(shuffle=True) on a from_batch_iterable stream
+    must actually randomize order (windowed buffer), deterministically
+    per (seed, epoch), while preserving the exact sample multiset."""
+    ds = Dataset.from_batch_iterable(
+        lambda: _chunks([7, 9, 8, 6, 10, 8]), size=48, shuffle_buffer=16)
+    ordered = np.concatenate(
+        [b[0][:, 0] for b in ds.batches(8, shuffle=False)])
+    shuf1 = np.concatenate(
+        [b[0][:, 0] for b in ds.batches(8, shuffle=True, seed=1, epoch=0)])
+    shuf1b = np.concatenate(
+        [b[0][:, 0] for b in ds.batches(8, shuffle=True, seed=1, epoch=0)])
+    shuf2 = np.concatenate(
+        [b[0][:, 0] for b in ds.batches(8, shuffle=True, seed=1, epoch=1)])
+    assert not np.array_equal(shuf1, ordered), "shuffle was a no-op"
+    np.testing.assert_array_equal(shuf1, shuf1b)   # deterministic
+    assert not np.array_equal(shuf1, shuf2)        # varies per epoch
+    # same multiset of samples — nothing lost or duplicated
+    np.testing.assert_array_equal(np.sort(shuf1), np.sort(ordered))
+    # labels stay paired with their rows: x rows encode their own index,
+    # so re-running unshuffled and indexing y by shuffled x matches
+    xs, ys = zip(*ds.batches(8, shuffle=True, seed=3, epoch=0))
+    x_all = np.concatenate([x[:, 0] for x in xs]).astype(int)
+    y_all = np.concatenate(ys)
+    _, y_ref = zip(*ds.batches(8, shuffle=False))
+    y_ref = np.concatenate(y_ref)
+    np.testing.assert_array_equal(y_all, y_ref[x_all])
+
+
+def test_windowed_shuffle_bounded_window():
+    """The shuffle buffer must not materialize the stream: displacement
+    from source order is bounded by ~one window."""
+    n, window = 4000, 256
+    ds = Dataset.from_batch_iterable(
+        lambda: _chunks([40] * 100), size=n, shuffle_buffer=window)
+    out = np.concatenate(
+        [b[0][:, 0] for b in ds.batches(32, shuffle=True, seed=0)])
+    displacement = np.abs(out - np.arange(len(out)))
+    # a row can ride the carried tail into the next window: displacement
+    # is bounded by ~2 windows (+ chunk slack), far below the stream size
+    assert displacement.max() <= 2 * window + 80, displacement.max()
+    # and it genuinely permutes within windows
+    assert (displacement > 0).mean() > 0.9
+
+
+def test_shuffle_buffer_none_replays_source_order():
+    ds = Dataset.from_batch_iterable(
+        lambda: _chunks([8, 8, 8]), size=24, shuffle_buffer=None)
+    a = np.concatenate([b[0][:, 0] for b in ds.batches(8, shuffle=True)])
+    np.testing.assert_array_equal(a, np.arange(24, dtype=np.float32))
+
+
 def test_stream_is_pulled_lazily():
     """The source generator advances only as far as the consumer pulls —
     the stream is never materialized."""
