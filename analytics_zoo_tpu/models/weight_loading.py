@@ -157,13 +157,44 @@ def load_tf_keras_weights(net, keras_model) -> object:
     return _apply(net, params, state)
 
 
+def _dense_flatten_reorders(net) -> Dict[str, tuple]:
+    """dense-layer-name -> (H, W, C) when the dense input IS a Flatten
+    of a 4-D NHWC feature map.  Torch flattens NCHW (row index
+    c·H·W + h·W + w) while this framework flattens NHWC, so the first
+    linear after a conv→flatten boundary needs its input rows
+    permuted — the classic layout trap of every torch importer."""
+    from ..pipeline.api.keras.layers.core import Dense, Flatten
+    out: Dict[str, tuple] = {}
+    for v in net.to_graph().nodes:
+        if not isinstance(v.layer, Dense) or not v.inputs:
+            continue
+        # walk back through shape-preserving pass-throughs (Dropout,
+        # Activation, ...) — torch heads are commonly
+        # Flatten -> Dropout -> Linear
+        src = v.inputs[0]
+        hops = 0
+        while (not isinstance(getattr(src, "layer", None), Flatten)
+               and len(src.inputs) == 1
+               and src.shape == src.inputs[0].shape and hops < 8):
+            src = src.inputs[0]
+            hops += 1
+        if isinstance(getattr(src, "layer", None), Flatten) \
+                and src.inputs and len(src.inputs[0].shape) == 4:
+            _, h, w, c = src.inputs[0].shape
+            out[v.layer.name] = (h, w, c)
+    return out
+
+
 def load_torch_state_dict(net, state_dict) -> object:
     """Transfer a PyTorch ``state_dict`` into ``net`` by op order.
 
     Layout conversion (the reference's weightConverter traps):
-    conv OIHW → HWIO (transpose 2,3,1,0); linear (out,in) → (in,out).
+    conv OIHW → HWIO (transpose 2,3,1,0); linear (out,in) → (in,out),
+    with the first linear after a conv→Flatten boundary additionally
+    re-indexed from torch's CHW flatten order to NHWC's HWC order.
     BN weight/bias → gamma/beta, running stats → moving stats."""
     ours = _our_layers_by_kind(net)
+    reorders = _dense_flatten_reorders(net)
     # group torch entries by module prefix, preserving insertion order
     # (state_dict insertion order IS construction order in torch)
     groups: Dict[str, Dict[str, np.ndarray]] = {}
@@ -194,6 +225,12 @@ def load_torch_state_dict(net, state_dict) -> object:
             params[ol.name] = entry
         elif ok == "dense":
             w = g["weight"].T  # (out,in) → (in,out)
+            hwc = reorders.get(ol.name)
+            if hwc is not None and w.shape[0] == int(np.prod(hwc)):
+                h, ww, c = hwc
+                # torch rows are (C, H, W)-ordered; ours are (H, W, C)
+                w = (w.reshape(c, h, ww, -1).transpose(1, 2, 0, 3)
+                     .reshape(h * ww * c, -1))
             entry = {"W": w}
             if getattr(ol, "bias", True):
                 entry["b"] = g.get("bias",

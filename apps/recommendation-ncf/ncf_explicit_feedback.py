@@ -1,20 +1,63 @@
-"""Explicit-feedback Neural Collaborative Filtering, MovieLens-style.
+"""Explicit-feedback Neural Collaborative Filtering on MovieLens.
 
 Reference analog: apps/recommendation-ncf/ncf-explicit-feedback.ipynb —
 load MovieLens ratings, 80/20 split, NeuralCF(class_num=5), Adam,
 validation (MAE + loss) every epoch, TensorBoard summaries read back
 into loss curves, then predict_user_item_pair / recommend_for_user /
-recommend_for_item / evaluate(MAE).
+recommend_for_item / evaluate(MAE), plus the implicit-feedback
+HitRatio/NDCG protocol.
 
-No network egress here, so ratings are synthetic MovieLens-shaped data:
-users and items carry latent factors and the 1..5 rating follows their
-affinity, giving the model real structure to learn.
+REAL DATA: pass ``--data /path/to/ml-1m`` (or a ratings file directly).
+Both MovieLens wire formats parse:
+
+- ml-1m ``ratings.dat``   — ``UserID::MovieID::Rating::Timestamp``
+- ml-100k ``u.data``      — tab-separated ``user item rating ts``
+
+Download (outside this sandbox):
+``https://files.grouplens.org/datasets/movielens/ml-1m.zip``.
+The reference notebook on ml-1m reaches validation MAE ≈ 0.75 and
+accuracy ≈ 0.45 with this architecture/optimizer after a few epochs;
+the implicit protocol's ballpark is HR@10 ≈ 0.5-0.6 at neg_num=99.
+
+Without ``--data`` the app falls back to synthetic MovieLens-shaped
+ratings (latent-factor affinity, same value ranges) so it always runs
+to its metrics.
 """
 
 import argparse
+import os
 import tempfile
 
 import numpy as np
+
+
+def load_movielens(path):
+    """Parse MovieLens ratings: ml-1m ``ratings.dat`` (``::`` separated)
+    or ml-100k ``u.data`` (tab separated).  ``path`` may be the dataset
+    directory or the ratings file itself.  Returns int32 rows of
+    (user, item, rating) with users/items 1-based, ratings 1..5."""
+    if os.path.isdir(path):
+        for cand in ("ratings.dat", "u.data"):
+            f = os.path.join(path, cand)
+            if os.path.exists(f):
+                path = f
+                break
+        else:
+            raise FileNotFoundError(
+                f"no ratings.dat / u.data under {path}")
+    rows = []
+    with open(path, encoding="latin-1") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split("::") if "::" in line else line.split()
+            u, i, r = int(parts[0]), int(parts[1]), int(float(parts[2]))
+            rows.append((u, i, r))
+    data = np.asarray(rows, np.int32)
+    if not len(data):
+        raise ValueError(f"no ratings parsed from {path}")
+    return data
 
 
 def synthetic_movielens(n_users, n_items, n_ratings, seed=0):
@@ -34,6 +77,10 @@ def synthetic_movielens(n_users, n_items, n_ratings, seed=0):
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None,
+                    help="MovieLens dir or ratings file (ml-1m "
+                         "ratings.dat / ml-100k u.data); synthetic "
+                         "fallback when omitted")
     ap.add_argument("--epochs", type=int, default=4)
     ap.add_argument("--users", type=int, default=100)
     ap.add_argument("--items", type=int, default=80)
@@ -47,7 +94,19 @@ def main():
     from analytics_zoo_tpu.train.summary import read_scalars
 
     init_nncontext("NCF Example")
-    data = synthetic_movielens(args.users, args.items, args.ratings)
+    if args.data:
+        data = load_movielens(args.data)
+        args.users = int(data[:, 0].max())
+        args.items = int(data[:, 1].max())
+        # real-data scale: the reference notebook's batch size (2800),
+        # clamped so tiny subsets still make at least a few steps/epoch
+        args.batch_size = max(args.batch_size,
+                              min(2800, max(len(data) // 10, 1)))
+        print(f"loaded MovieLens: {len(data)} ratings, "
+              f"{args.users} users, {args.items} items")
+    else:
+        data = synthetic_movielens(args.users, args.items, args.ratings)
+        print("synthetic fallback (pass --data for MovieLens)")
     print("ratings:", data.shape, "users", data[:, 0].min(), "..",
           data[:, 0].max(), "items", data[:, 1].min(), "..",
           data[:, 1].max(), "labels", np.unique(data[:, 2]))
@@ -75,8 +134,9 @@ def main():
     # read the summaries back, notebook-style loss curves as text
     loss = read_scalars(log_dir, "ncf", "Loss")
     val_mae = read_scalars(log_dir, "ncf", "mae", split="validation")
-    print("train Loss points:", len(loss),
-          "first %.3f last %.3f" % (loss[0][1], loss[-1][1]))
+    if loss:
+        print("train Loss points:", len(loss),
+              "first %.3f last %.3f" % (loss[0][1], loss[-1][1]))
     if val_mae:
         print("val MAE per epoch:",
               ["%.3f" % v for _, v in val_mae])
@@ -104,10 +164,19 @@ def main():
     from analytics_zoo_tpu.pipeline.api.keras.metrics import HitRatio, NDCG
 
     positives = [(int(u), int(i)) for u, i, r in data if r >= 4]
-    negatives = get_negative_samples(positives, item_count=args.items,
+    # HOLD OUT the ranking-eval positives (random across users — ml-1m
+    # is user-sorted, so a head slice would cover a handful of users)
+    # before training, so HR/NDCG measure unseen positives
+    neg_num, k = (99, 10) if args.data else (9, 3)
+    n_eval = min(1000 if args.data else 100, len(positives) // 5 or 1)
+    rs3 = np.random.RandomState(3)
+    perm_p = rs3.permutation(len(positives))
+    eval_pos = [positives[i] for i in perm_p[:n_eval]]
+    train_pos = [positives[i] for i in perm_p[n_eval:]]
+    negatives = get_negative_samples(train_pos, item_count=args.items,
                                      neg_per_pos=2, seed=2)
-    xi = np.array(positives + negatives, np.int32)
-    yi = np.concatenate([np.ones(len(positives)),
+    xi = np.array(train_pos + negatives, np.int32)
+    yi = np.concatenate([np.ones(len(train_pos)),
                          np.zeros(len(negatives))]).astype(np.int32)
     implicit = NeuralCF(user_count=args.users, item_count=args.items,
                         num_classes=2, hidden_layers=(20, 10),
@@ -116,10 +185,9 @@ def main():
     perm2 = rs.permutation(len(xi))
     implicit.fit(xi[perm2], yi[perm2], batch_size=args.batch_size,
                  nb_epoch=args.epochs)
-    neg_num = 9
     ex, ey = [], []
     pos_set = set(positives)
-    for u, i in positives[:100]:
+    for u, i in eval_pos:
         ex.append((u, i)); ey.append(1)
         drawn, j = 0, 1
         while drawn < neg_num:
@@ -130,10 +198,13 @@ def main():
     ranked = implicit.evaluate(
         np.array(ex, np.int32), np.array(ey, np.int32),
         batch_size=(neg_num + 1) * 10,
-        metrics=[HitRatio(k=3, neg_num=neg_num),
-                 NDCG(k=3, neg_num=neg_num)])
-    print(f"implicit feedback: HitRatio@3 {ranked['hit_ratio@3']:.3f} "
-          f"NDCG@3 {ranked['ndcg@3']:.3f} (chance hit@3 of 10 = 0.300)")
+        metrics=[HitRatio(k=k, neg_num=neg_num),
+                 NDCG(k=k, neg_num=neg_num)])
+    chance = k / (neg_num + 1)
+    print(f"implicit feedback (held-out positives): "
+          f"HitRatio@{k} {ranked[f'hit_ratio@{k}']:.3f} "
+          f"NDCG@{k} {ranked[f'ndcg@{k}']:.3f} "
+          f"(chance hit@{k} of {neg_num + 1} = {chance:.3f})")
     print("ncf app done")
 
 

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Fetch public pretrained checkpoints for the model-zoo import path.
+
+The zoo's pretrained story (reference:
+ImageClassificationConfig.scala:34-50 serves pretrained models per
+registry name) imports public checkpoints through
+``models/weight_loading.py``.  This script downloads them where egress
+exists; ``tests/test_pretrained_e2e.py`` picks them up from the cache
+dir and runs the accuracy gate.
+
+Usage:
+    python scripts/fetch_pretrained.py [--dest ~/.cache/zoo_tpu_pretrained]
+                                       [--model inception-v3|resnet-50|all]
+
+Sources (both public, stable URLs):
+  - inception-v3: tf.keras applications ImageNet weights
+    (storage.googleapis.com/tensorflow/keras-applications/...)
+  - resnet-50: torchvision IMAGENET1K_V1
+    (download.pytorch.org/models/resnet50-0676ba61.pth)
+
+Labeled validation images are NOT fetched (ImageNet samples are not
+freely redistributable); the e2e test checks top-1 agreement between
+the imported model and its source framework instead.
+"""
+
+import argparse
+import os
+import sys
+
+DEST_DEFAULT = os.path.expanduser("~/.cache/zoo_tpu_pretrained")
+
+KERAS_INCEPTION_V3 = (
+    "https://storage.googleapis.com/tensorflow/keras-applications/"
+    "inception_v3/inception_v3_weights_tf_dim_ordering_tf_kernels.h5")
+TORCH_RESNET50 = "https://download.pytorch.org/models/resnet50-0676ba61.pth"
+
+
+def fetch(url, dest):
+    import urllib.request
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    if os.path.exists(dest):
+        print(f"cached: {dest}")
+        return dest
+    print(f"fetching {url} -> {dest}")
+    tmp = dest + ".part"
+    urllib.request.urlretrieve(url, tmp)
+    os.replace(tmp, dest)
+    return dest
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dest", default=DEST_DEFAULT)
+    ap.add_argument("--model", default="all",
+                    choices=["inception-v3", "resnet-50", "all"])
+    args = ap.parse_args()
+
+    got = []
+    try:
+        if args.model in ("inception-v3", "all"):
+            got.append(fetch(KERAS_INCEPTION_V3,
+                             os.path.join(args.dest, "inception_v3.h5")))
+        if args.model in ("resnet-50", "all"):
+            got.append(fetch(TORCH_RESNET50,
+                             os.path.join(args.dest, "resnet50_imagenet.pth")))
+    except Exception as e:
+        print(f"download failed ({type(e).__name__}: {e}) — no egress? "
+              "Run this where the internet is reachable and copy "
+              f"{args.dest} across.", file=sys.stderr)
+        return 1
+    print("done:", *got, sep="\n  ")
+    print("verify end-to-end with: "
+          "pytest tests/test_pretrained_e2e.py -q")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""The flagship apps' real-dataset loaders (VERDICT r3 #7), tested
+against small format-true fixtures: ml-1m ratings.dat / ml-100k u.data,
+NAB nyc_taxi.csv, and the aclImdb directory layout."""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+APPS = os.path.join(os.path.dirname(__file__), "..", "apps")
+
+
+def _load(app_dir, module_file):
+    path = os.path.join(APPS, app_dir, module_file)
+    spec = importlib.util.spec_from_file_location(
+        module_file[:-3] + "_" + app_dir.replace("-", "_"), path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_movielens_1m_format(tmp_path):
+    ncf = _load("recommendation-ncf", "ncf_explicit_feedback.py")
+    f = tmp_path / "ratings.dat"
+    f.write_text("1::1193::5::978300760\n"
+                 "1::661::3::978302109\n"
+                 "2::1357::5::978298709\n"
+                 "6040::562::5::956704746\n")
+    data = ncf.load_movielens(str(f))
+    np.testing.assert_array_equal(
+        data, [[1, 1193, 5], [1, 661, 3], [2, 1357, 5], [6040, 562, 5]])
+    # directory form resolves ratings.dat
+    data2 = ncf.load_movielens(str(tmp_path))
+    np.testing.assert_array_equal(data, data2)
+
+
+def test_movielens_100k_format(tmp_path):
+    ncf = _load("recommendation-ncf", "ncf_explicit_feedback.py")
+    f = tmp_path / "u.data"
+    f.write_text("196\t242\t3\t881250949\n"
+                 "186\t302\t3\t891717742\n"
+                 "22\t377\t1\t878887116\n")
+    data = ncf.load_movielens(str(tmp_path))
+    np.testing.assert_array_equal(
+        data, [[196, 242, 3], [186, 302, 3], [22, 377, 1]])
+    with pytest.raises(FileNotFoundError):
+        ncf.load_movielens(str(tmp_path / "nope"))
+
+
+def test_nab_nyc_taxi_format(tmp_path):
+    an = _load("anomaly-detection", "anomaly_detection.py")
+    f = tmp_path / "nyc_taxi.csv"
+    f.write_text("timestamp,value\n"
+                 "2014-07-01 00:00:00,10844\n"
+                 "2014-07-01 00:30:00,8127\n"
+                 "2014-11-02 01:00:00,20553\n")   # inside marathon window
+    series, ts = an.load_nyc_taxi(str(f))
+    np.testing.assert_allclose(series, [10844, 8127, 20553])
+    truth = an.nab_truth_mask(ts)
+    # only the marathon-window timestamp is anomalous
+    np.testing.assert_array_equal(truth, [False, False, True])
+    assert len(an.NAB_ANOMALY_WINDOWS) == 5
+
+
+def test_aclimdb_layout(tmp_path):
+    sent = _load("sentiment-analysis", "sentiment.py")
+    for split in ("train", "test"):
+        for lab in ("pos", "neg"):
+            d = tmp_path / split / lab
+            d.mkdir(parents=True)
+            for i in range(3):
+                (d / f"{i}_7.txt").write_text(
+                    f"This movie was {'great fun' if lab == 'pos' else 'a dull bore'} number {i}.")
+    texts, labels = sent.load_imdb(str(tmp_path), "train")
+    assert len(texts) == 6 and labels.sum() == 3
+    vocab = sent.build_vocab(texts, max_words=50)
+    assert "movie" in vocab and min(vocab.values()) >= 2
+    x = sent.vectorize(texts, vocab, seq_len=8)
+    assert x.shape == (6, 8) and x.max() < 50
+    # OOV words map to 1, padding stays 0
+    x2 = sent.vectorize(["zzzunseen word"], vocab, seq_len=4)
+    assert x2[0, 0] == 1 and x2[0, -1] == 0

@@ -99,7 +99,19 @@ class TestApps:
         assert "ncf app done" in out
         assert "top-3 items per user" in out
         assert "val MAE per epoch" in out  # summaries round-trip from disk
-        assert "implicit feedback: HitRatio@3" in out
+        assert "HitRatio@3" in out
+
+    def test_anomaly_detection_app(self):
+        out = run_example("apps/anomaly-detection/anomaly_detection.py",
+                          "--epochs", "1")
+        assert "synthetic fallback" in out
+        assert "true anomalies hit=" in out
+
+    def test_sentiment_app(self):
+        out = run_example("apps/sentiment-analysis/sentiment.py",
+                          "--epochs", "1")
+        assert "synthetic fallback" in out
+        assert "test metrics:" in out
 
     def test_recommendation_wnd_app(self):
         out = run_example("apps/recommendation-wide-n-deep/wide_n_deep.py",
