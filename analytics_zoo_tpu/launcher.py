@@ -148,5 +148,75 @@ def main(argv: Optional[List[str]] = None) -> int:
     return rc
 
 
+def shell_main(argv: Optional[List[str]] = None) -> int:
+    """``zoo-tpu-shell`` — the interactive-session launcher.
+
+    Parity surface: reference ``scripts/jupyter-with-zoo.sh`` /
+    ``pyspark-with-zoo.sh`` — open an interactive environment with the
+    framework context already up.  ``zoo-tpu-shell`` starts an IPython
+    (or plain) REPL with ``init_nncontext`` done and the common names
+    bound; ``zoo-tpu-shell --jupyter`` execs Jupyter with the
+    environment prepared the same way.
+    """
+    parser = argparse.ArgumentParser(
+        prog="zoo-tpu-shell",
+        description="Interactive REPL/Jupyter with the analytics-zoo-tpu "
+                    "context initialized (reference jupyter-with-zoo.sh)")
+    parser.add_argument("--jupyter", action="store_true",
+                        help="launch jupyter notebook instead of a REPL")
+    parser.add_argument("--app-name", default="zoo-tpu-shell")
+    parser.add_argument("--platform", default=None,
+                        help="force JAX_PLATFORMS (e.g. cpu)")
+    parser.add_argument("--cpu-devices", type=int, default=None,
+                        help="virtual CPU device count (sets "
+                             "--xla_force_host_platform_device_count)")
+    parser.add_argument("jupyter_args", nargs=argparse.REMAINDER,
+                        help="passed through to jupyter")
+    args = parser.parse_args(argv)
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    if args.cpu_devices:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", "")).strip()
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            f"{args.cpu_devices}").strip()
+    if args.platform and not args.jupyter:
+        # an auto-registering accelerator plugin can pre-empt the env
+        # var alone; pin the platform through jax.config too (env/flags
+        # above are already set, so importing jax here is safe)
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    if args.jupyter:
+        # exec jupyter in the prepared environment (the reference sets
+        # PYSPARK_DRIVER_PYTHON=jupyter; here the env vars above are the
+        # whole contract)
+        cmd = ["jupyter", "notebook"] + [
+            a for a in args.jupyter_args if a != "--"]
+        os.execvp(cmd[0], cmd)
+
+    import analytics_zoo_tpu as zoo
+    ctx = zoo.init_nncontext(args.app_name)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    ns = {"zoo": zoo, "ctx": ctx, "jax": jax, "jnp": jnp, "np": np}
+    banner = (f"analytics-zoo-tpu shell — ctx up "
+              f"(mesh {dict(zip(ctx.mesh.axis_names, ctx.mesh.devices.shape))})\n"
+              "bound: zoo, ctx, jax, jnp, np")
+    print(banner)
+    try:
+        from IPython import start_ipython
+        # display_banner is a Bool trait — the banner prints above,
+        # IPython's own is suppressed via --no-banner
+        return start_ipython(argv=["--no-banner"], user_ns=ns) or 0
+    except ImportError:
+        import code
+        code.interact(banner="", local=ns)
+        return 0
+
+
 if __name__ == "__main__":
     sys.exit(main())

@@ -129,6 +129,35 @@ def _bn_bwd(eps, ch_axis, res, cts):
 batch_norm_train.defvjp(_bn_fwd, _bn_bwd)
 
 
+# A/B switch for the perf harness: when True, BatchNormalization traces
+# the pre-r4 naive formulation (jnp.mean + jnp.var + autodiff backward)
+# instead of the restructured custom-VJP core.  Trace-time only — flip
+# it between building two jitted step functions to measure both.
+USE_NAIVE = False
+
+
+def set_naive_bn(flag: bool):
+    global USE_NAIVE
+    USE_NAIVE = bool(flag)
+
+
+def batch_norm_train_naive(x, gamma, beta, eps, ch_axis):
+    """The pre-restructuring formulation (two reduction passes over an
+    f32 cast, XLA-autodiff backward) — kept for the bench's A/B."""
+    axes, _ = _reduce_axes_and_count(x, ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes)
+    var = jnp.var(x32, axis=axes)
+    dt = x.dtype
+    inv = gamma.astype(dt).reshape(bshape) * (
+        1.0 / jnp.sqrt(var.astype(dt).reshape(bshape) + eps))
+    out = (x - mean.astype(dt).reshape(bshape)) * inv \
+        + beta.astype(dt).reshape(bshape)
+    return out, jax.lax.stop_gradient(mean), jax.lax.stop_gradient(var)
+
+
 def batch_norm_inference(x, gamma, beta, mean, var, eps, ch_axis):
     """Eval-mode BN with moving statistics (plain XLA; fuses fully)."""
     dt = x.dtype

@@ -54,8 +54,12 @@ class BatchNormalization(Layer):
             # restructured train-mode core (ops/batchnorm.py): one-pass
             # fused statistics + closed-form custom VJP — statistics
             # accumulate in f32 regardless of compute dtype, and the
-            # moving-stat update is stop-gradient (BigDL running stats)
-            out, mean, var = batch_norm_train(
+            # moving-stat update is stop-gradient (BigDL running stats).
+            # USE_NAIVE is the bench's A/B switch (trace-time).
+            from .....ops import batchnorm as bn_lib
+            bn_fn = (bn_lib.batch_norm_train_naive if bn_lib.USE_NAIVE
+                     else batch_norm_train)
+            out, mean, var = bn_fn(
                 inputs, params["gamma"], params["beta"],
                 self.epsilon, ch_axis)
             m = self.momentum

@@ -184,10 +184,66 @@ def child(platform: str):
     # same compiled step through the streaming dataset + prefetch ----
     try:
         extras["input_fed"] = _bench_input_fed(
-            jax, jnp, np, graph, loss_fn, optimizer, batch, size, on_tpu)
+            jax, jnp, np, graph, loss_fn, optimizer, batch, size, on_tpu,
+            step_only_ms=best * 1e3)
     except Exception as e:
         extras["input_fed"] = {"error": f"{type(e).__name__}: {e}"}
         _log(f"input-fed bench failed: {e}")
+
+    # ---- BN restructuring A/B (VERDICT r3 #2): same step, naive BN ----
+    # (two reduction passes + autodiff backward) vs the r4 custom-VJP
+    # core the model now uses.  Interleaved in one process.
+    if _extras_budget_left("bn_ab", 260 if on_tpu else 60):
+        from analytics_zoo_tpu.ops import batchnorm as bn_lib
+        try:
+            bn_lib.set_naive_bn(True)
+            naive_step = build_train_step(graph, loss_fn, optimizer,
+                                          compute_dtype=jnp.bfloat16)
+            p2, s2 = graph.init(jax.random.PRNGKey(2))
+            o2 = optimizer.init(p2)
+            p2, s2, o2, nl = naive_step(p2, s2, o2, key, x, y)
+            _ = float(nl)
+            naive_best = 1e9
+            for _ in range(3 if on_tpu else 1):
+                t0 = time.time()
+                for _ in range(steps):
+                    p2, s2, o2, nl = naive_step(p2, s2, o2, key, x, y)
+                _ = float(nl)
+                naive_best = min(naive_best, (time.time() - t0) / steps)
+            # flag OFF before touching the restructured step: a shape-
+            # triggered retrace of `jitted` must not trace naive BN
+            bn_lib.set_naive_bn(False)
+            # re-measure the restructured step interleaved (shared-chip
+            # contention fairness, PERF_NOTES methodology)
+            restruct_best = 1e9
+            for _ in range(3 if on_tpu else 1):
+                t0 = time.time()
+                for _ in range(steps):
+                    params, state, opt_state, loss = jitted(
+                        params, state, opt_state, key, x, y)
+                _ = float(loss)
+                restruct_best = min(restruct_best,
+                                    (time.time() - t0) / steps)
+            extras["bn_ab"] = {
+                "naive_ms": round(naive_best * 1e3, 2),
+                "restructured_ms": round(restruct_best * 1e3, 2),
+                "speedup": round(naive_best / restruct_best, 3)}
+            _log(f"bn A/B: naive {naive_best * 1e3:.2f} ms vs "
+                 f"restructured {restruct_best * 1e3:.2f} ms "
+                 f"({extras['bn_ab']['speedup']}x)")
+            # the headline uses the better interleaved figure
+            if restruct_best < best:
+                best = restruct_best
+                images_per_sec = batch / best
+        except Exception as e:
+            extras["bn_ab"] = {"error": f"{type(e).__name__}: {e}"}
+            _log(f"bn A/B failed: {e}")
+        finally:
+            # never leave the process tracing naive BN (a mid-section
+            # failure would silently poison every later retrace)
+            bn_lib.set_naive_bn(False)
+    else:
+        extras["bn_ab"] = {"skipped": "extras deadline"}
 
     # ---- MFU: achieved flops / peak flops for this chip ----
     if step_flops is None:
@@ -245,12 +301,17 @@ def child(platform: str):
 
 
 def _bench_input_fed(jax, jnp, np, graph, loss_fn, optimizer, batch, size,
-                     on_tpu):
+                     on_tpu, step_only_ms=None):
     """End-to-end throughput: JPEG folder → native decode (uint8) →
     streaming re-batch → async device_put (prefetch) → one compiled step
     that normalizes ON DEVICE then trains.  uint8 transfer is 4× smaller
     than f32 — host→device bandwidth is the testbed's wall
-    (PERF_NOTES.md)."""
+    (PERF_NOTES.md).
+
+    VERDICT r3 #3: reports a PER-STAGE decomposition — decode-only,
+    H2D-only, dispatch/step-only, and the overlapped end-to-end — so a
+    gap between input-fed and step-only is *attributed* to a measured
+    stage, not asserted onto the substrate."""
     from analytics_zoo_tpu.data.dataset import Dataset, prefetch_iterator
     from analytics_zoo_tpu.data.image_loader import ImageLoader
     from analytics_zoo_tpu.train.trainer import build_train_step
@@ -292,9 +353,47 @@ def _bench_input_fed(jax, jnp, np, graph, loss_fn, optimizer, batch, size,
     ips = steps * batch / elapsed
     _log(f"input-fed: {steps} steps, {elapsed:.2f}s -> {ips:.1f} img/s "
          f"(native decode: {native.available()}, uint8 transfer)")
-    return {"images_per_sec": round(ips, 2), "steps": steps,
-            "native_decode": bool(native.available()),
-            "transfer_dtype": "uint8", "n_images": n_images}
+    out = {"images_per_sec": round(ips, 2), "steps": steps,
+           "native_decode": bool(native.available()),
+           "transfer_dtype": "uint8", "n_images": n_images}
+
+    # ---- per-stage decomposition ----
+    stages = {}
+    # (a) decode-only: pull the whole epoch through decode+rebatch with
+    # no device work at all
+    t0 = time.time()
+    rows = 0
+    for bx, by in ds.batches(batch):
+        rows += len(by)
+    stages["decode_img_per_s"] = round(rows / (time.time() - t0), 1)
+    # (b) H2D-only: one pre-decoded uint8 batch, synchronous device_put
+    # + block, best of several — bytes/s through the link
+    first = next(iter(ds.batches(batch)))
+    bx_host = np.ascontiguousarray(first[0])
+    nbytes = bx_host.nbytes
+    h2d_best = 1e9
+    for _ in range(6 if on_tpu else 2):
+        t0 = time.time()
+        dev_arr = jax.device_put(bx_host)
+        dev_arr.block_until_ready()
+        h2d_best = min(h2d_best, time.time() - t0)
+    stages["h2d_mb_per_s"] = round(nbytes / h2d_best / 1e6, 1)
+    stages["h2d_img_per_s"] = round(batch / h2d_best, 1)
+    # (c) dispatch/step-only on device-resident data (the compute wall)
+    if step_only_ms is not None:
+        stages["step_only_img_per_s"] = round(batch / (step_only_ms / 1e3),
+                                              1)
+    # (d) the pipeline bound: with perfect overlap, throughput is the
+    # min of the stages; the measured end-to-end shows the overlap gap
+    bound = min(v for k, v in stages.items() if k.endswith("img_per_s"))
+    stages["pipeline_bound_img_per_s"] = round(bound, 1)
+    stages["overlap_efficiency"] = round(ips / max(bound, 1e-9), 3)
+    out["stages"] = stages
+    _log(f"input decomposition: decode {stages['decode_img_per_s']} img/s, "
+         f"h2d {stages['h2d_mb_per_s']} MB/s "
+         f"({stages['h2d_img_per_s']} img/s), bound "
+         f"{bound} img/s, overlap {stages['overlap_efficiency']}")
+    return out
 
 
 def _bench_ncf(jax, jnp, np, on_tpu: bool):
@@ -357,21 +456,25 @@ def _bench_ncf(jax, jnp, np, on_tpu: bool):
 
 
 def _bench_int8(jax, jnp, np, on_tpu: bool):
-    """VGG-16 inference, int8 vs f32, interleaved — the reference's
-    quantization headline is "up to 2x inference speedup, 4x model-size
-    reduction" (wp-bigdl.md:192-196) on SSD/VGG.  Iteration loop inside
-    one jit (lax.scan) per the tunnel-floor methodology."""
-    from analytics_zoo_tpu.models.image.classification import vgg16
+    """int8 vs f32 inference, interleaved — the reference's quantization
+    headline is "up to 2x inference speedup, 4x model-size reduction"
+    (wp-bigdl.md:192-196) on SSD/VGG.  On TPU, BOTH vgg-16 and
+    resnet-50 are measured (VERDICT r3 #4); the CPU fallback keeps one
+    small model.  Iteration loop inside one jit (lax.scan) per the
+    tunnel-floor methodology.  Accuracy evidence lives in
+    tests/test_pretrained_e2e.py::test_int8_accuracy_on_trained_model
+    (platform-independent)."""
+    from analytics_zoo_tpu.models.image.classification import (resnet50,
+                                                               vgg16)
     from analytics_zoo_tpu.ops.quantize import (quantize_graph,
                                                 quantized_size_bytes)
 
     batch = 32 if on_tpu else 2
     size = 224 if on_tpu else 32
     n_steps = 12 if on_tpu else 2
-    model = vgg16(input_shape=(size, size, 3), num_classes=1000)
-    graph = model.to_graph()
-    params, state = graph.init(jax.random.PRNGKey(0))
-    qgraph, qparams, qstate = quantize_graph(graph, params, state)
+    models = {"vgg-16": vgg16}
+    if on_tpu:
+        models["resnet-50"] = resnet50
 
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(batch, size, size, 3)),
@@ -389,34 +492,45 @@ def _bench_int8(jax, jnp, np, on_tpu: bool):
             return ys[-1]
         return run
 
-    runs = {"f32": make_run(graph, params, state),
-            "int8": make_run(qgraph, qparams, qstate)}
-    best = {}
-    for name, run in runs.items():
-        _ = float(run())  # compile + warm
-    for _ in range(3 if on_tpu else 1):
+    out = {"batch": batch, "models": {}}
+    for mname, builder in models.items():
+        model = builder(input_shape=(size, size, 3), num_classes=1000)
+        graph = model.to_graph()
+        params, state = graph.init(jax.random.PRNGKey(0))
+        qgraph, qparams, qstate = quantize_graph(graph, params, state)
+        runs = {"f32": make_run(graph, params, state),
+                "int8": make_run(qgraph, qparams, qstate)}
+        best = {}
         for name, run in runs.items():
-            t0 = time.time()
-            _ = float(run())
-            dt = (time.time() - t0) / n_steps
-            best[name] = min(best.get(name, 1e9), dt)
-    f32_ips = batch / best["f32"]
-    int8_ips = batch / best["int8"]
-    size_f32 = sum(int(np.prod(np.shape(l))) * 4
-                   for l in jax.tree_util.tree_leaves(params))
-    size_int8 = quantized_size_bytes(qparams)
-    out = {"f32_images_per_sec": round(f32_ips, 1),
-           "int8_images_per_sec": round(int8_ips, 1),
-           "speedup": round(int8_ips / f32_ips, 3),
-           "model_size_ratio": round(size_f32 / max(size_int8, 1), 2),
-           "batch": batch, "model": "vgg-16"}
+            _ = float(run())  # compile + warm
+        for _ in range(3 if on_tpu else 1):
+            for name, run in runs.items():
+                t0 = time.time()
+                _ = float(run())
+                dt = (time.time() - t0) / n_steps
+                best[name] = min(best.get(name, 1e9), dt)
+        f32_ips = batch / best["f32"]
+        int8_ips = batch / best["int8"]
+        size_f32 = sum(int(np.prod(np.shape(l))) * 4
+                       for l in jax.tree_util.tree_leaves(params))
+        size_int8 = quantized_size_bytes(qparams)
+        entry = {"f32_images_per_sec": round(f32_ips, 1),
+                 "int8_images_per_sec": round(int8_ips, 1),
+                 "speedup": round(int8_ips / f32_ips, 3),
+                 "model_size_ratio": round(size_f32 / max(size_int8, 1),
+                                           2)}
+        out["models"][mname] = entry
+        _log(f"int8 {mname}: f32 {f32_ips:.0f} img/s, int8 "
+             f"{int8_ips:.0f} img/s ({entry['speedup']}x), size ratio "
+             f"{entry['model_size_ratio']}x")
+    # keep the r3 flat keys for the first model (artifact compatibility)
+    first = next(iter(out["models"].values()))
+    out.update({k: v for k, v in first.items()})
+    out["model"] = next(iter(out["models"]))
     if not on_tpu:
         out["note"] = ("CPU fallback: XLA:CPU has no accelerated int8 "
                        "conv path, so speedup here reflects the host, "
                        "not the int8 design — measure on TPU")
-    _log(f"int8 inference: f32 {f32_ips:.0f} img/s, int8 {int8_ips:.0f} "
-         f"img/s ({out['speedup']}x), size ratio "
-         f"{out['model_size_ratio']}x")
     return out
 
 

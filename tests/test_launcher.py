@@ -81,3 +81,50 @@ def test_pod_mode_requires_coordinator(tmp_path):
     proc2 = _submit(["--process-id", "3"], script)
     assert proc2.returncode != 0
     assert "--num-processes" in proc2.stdout
+
+
+def test_zoo_tpu_shell_repl(tmp_path):
+    """zoo-tpu-shell (reference jupyter-with-zoo.sh analog): the REPL
+    starts with the context up and the standard names bound, honoring
+    --platform/--cpu-devices."""
+    import subprocess, sys, os
+    code = (
+        "import sys, io\n"
+        "import unittest.mock as mock\n"
+        "with mock.patch.dict(sys.modules, {'IPython': None}):\n"
+        "    sys.stdin = io.StringIO(\n"
+        "        'print(\"NS\", \"zoo\" in dir(), \"ctx\" in dir(), "
+        "len(jax.devices()))\\n')\n"
+        "    from analytics_zoo_tpu.launcher import shell_main\n"
+        "    sys.exit(shell_main(['--platform', 'cpu', "
+        "'--cpu-devices', '4']))\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=240,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "NS True True 4" in proc.stdout, proc.stdout
+
+
+def test_zoo_tpu_shell_ipython_path(tmp_path):
+    """The PRIMARY shell path — IPython installed — must reach the REPL
+    (regression: passing a str banner to start_ipython's Bool trait
+    crashed before the prompt)."""
+    import subprocess, sys, os
+    pytest.importorskip("IPython")
+    code = (
+        "import sys, io\n"
+        "sys.stdin = io.StringIO('print(\"IPY_OK\", type(ctx).__name__)\\n"
+        "exit\\n')\n"
+        "from analytics_zoo_tpu.launcher import shell_main\n"
+        "sys.exit(shell_main(['--platform', 'cpu', "
+        "'--cpu-devices', '2']) or 0)\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["TERM"] = "dumb"
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=240,
+                          env=env)
+    assert proc.returncode == 0, (proc.stdout[-500:], proc.stderr[-500:])
+    assert "IPY_OK NNContext" in proc.stdout, proc.stdout[-500:]
