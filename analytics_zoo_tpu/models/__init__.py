@@ -1,5 +1,6 @@
 from .common import ZooModel, register_zoo_model
 from .textclassification import TextClassifier
+from .textgeneration import TransformerLM
 from .recommendation import (Recommender, NeuralCF, WideAndDeep,
                              UserItemFeature, UserItemPrediction,
                              ColumnFeatureInfo)

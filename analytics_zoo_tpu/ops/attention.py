@@ -244,6 +244,36 @@ def _largest_divisor(n: int, cap: int) -> int:
     return 1
 
 
+def attention_bhsd(q, k, v, causal: bool = False,
+                   implementation: str = "auto"):
+    """(b, h, s, d)-layout dispatch — the transpose-free fast path for
+    transformer stacks that project qkv straight into bhsd
+    (``einsum("bse,ehd->bhsd", ...)``; see flash_attention's layout
+    note).  On TPU the pallas kernel consumes the layout directly; on
+    other backends the arrays are transposed to the (b, s, h, d)
+    contract around blockwise/naive (cheap on CPU, where this path is
+    only a test oracle)."""
+    sq = q.shape[2]
+    bq, bk = _largest_divisor(sq, 256), _largest_divisor(k.shape[2], 1024)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if implementation == "flash" or (
+            implementation == "auto" and on_tpu and min(bq, bk) >= 8):
+        # explicit "flash" with no usable divisor RAISES inside
+        # flash_attention (never a silent O(S²) naive fallback)
+        return flash_attention(q, k, v, causal=causal, block_q=bq,
+                               block_k=bk, layout="bhsd",
+                               interpret=not on_tpu)
+    qs, ks, vs = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    if implementation == "blockwise" or (
+            implementation == "auto" and min(bq, bk) >= 8):
+        out = blockwise_attention(qs, ks, vs, causal=causal, block_k=bk)
+    elif implementation in ("auto", "naive"):
+        out = naive_attention(qs, ks, vs, causal=causal)
+    else:
+        raise ValueError(f"Unknown implementation {implementation!r}")
+    return out.transpose(0, 2, 1, 3)
+
+
 def attention(q, k, v, causal: bool = False, implementation: str = "auto"):
     """Dispatch: pallas on TPU, blockwise elsewhere; awkward sequence
     lengths (no usable block divisor) fall back to naive."""

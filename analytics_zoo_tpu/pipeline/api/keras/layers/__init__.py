@@ -28,5 +28,6 @@ from .torch_style import (
     Power, Mul, CAdd, CMul, Scale, GaussianSampler, KerasLayerWrapper,
     Narrow, Select, Squeeze)
 from .moe import SwitchMoE
+from .attention import MultiHeadSelfAttention, PositionalEmbedding
 from ..engine import Sequential, Model
 from .....core.graph import Input, InputLayer

@@ -1,0 +1,119 @@
+"""Attention layers — the TPU-era extension the reference lacks
+(SURVEY §5: "attention does not exist in the layer set"); long-context
+support is first-class here, so the ops-level stack
+(``ops/attention.py`` flash kernel, ``parallel/ring_attention``) gets a
+Keras-level consumer.
+
+Design note (the transpose-tax fix, PERF_NOTES r4): q/k/v are projected
+DIRECTLY into the (batch, heads, seq, head_dim) layout via
+``einsum("bse,ehd->bhsd", x, W)`` — XLA folds the layout into the
+projection matmul's output, and the pallas kernel's batch/head fold
+becomes a free reshape.  No materialized (b,s,h,d)→(b,h,s,d) transposes
+anywhere in the block.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core import initializers
+from .....core.module import Layer, register_layer
+from .....ops.attention import attention_bhsd
+
+
+@register_layer
+class MultiHeadSelfAttention(Layer):
+    """Multi-head self-attention over (batch, seq, d_model) inputs.
+
+    - ``n_heads`` × ``head_dim`` (default ``d_model // n_heads``)
+    - ``causal=True`` masks future positions (decoder-style)
+    - ``implementation``: "auto" (pallas flash kernel on TPU, blockwise
+      XLA elsewhere), "flash", "blockwise", or "naive"
+    """
+
+    def __init__(self, n_heads, head_dim=None, causal=True,
+                 implementation="auto", init="glorot_uniform",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.n_heads = int(n_heads)
+        self.head_dim = None if head_dim is None else int(head_dim)
+        self.causal = bool(causal)
+        self.implementation = implementation
+        self.init_name = init
+
+    def _dims(self, d_model):
+        hd = self.head_dim or d_model // self.n_heads
+        if hd * self.n_heads != d_model and self.head_dim is None:
+            raise ValueError(
+                f"d_model ({d_model}) not divisible by n_heads "
+                f"({self.n_heads}); pass head_dim explicitly")
+        return hd
+
+    def init_params(self, rng, input_shape):
+        d_model = input_shape[-1]
+        hd = self._dims(d_model)
+        init = initializers.get(self.init_name)
+        ks = jax.random.split(rng, 4)
+        return {
+            # (d_model, heads, head_dim): the bhsd projection layout
+            "Wq": init(ks[0], (d_model, self.n_heads, hd)),
+            "Wk": init(ks[1], (d_model, self.n_heads, hd)),
+            "Wv": init(ks[2], (d_model, self.n_heads, hd)),
+            # (heads, head_dim, d_model): output projection
+            "Wo": init(ks[3], (self.n_heads, hd, d_model)),
+        }
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        # project straight into (b, h, s, d) — layout rides the matmul
+        q = jnp.einsum("bse,ehd->bhsd", inputs, params["Wq"])
+        k = jnp.einsum("bse,ehd->bhsd", inputs, params["Wk"])
+        v = jnp.einsum("bse,ehd->bhsd", inputs, params["Wv"])
+        o = attention_bhsd(q, k, v, causal=self.causal,
+                           implementation=self.implementation)
+        return jnp.einsum("bhsd,hde->bse", o, params["Wo"])
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(n_heads=self.n_heads, head_dim=self.head_dim,
+                   causal=self.causal, implementation=self.implementation,
+                   init=self.init_name)
+        return cfg
+
+
+@register_layer
+class PositionalEmbedding(Layer):
+    """Learned positional table added to a (batch, seq, d_model) input:
+    ``y = x + table[:seq]``.  ``max_len`` bounds the trainable table;
+    shorter sequences slice it (static shapes under jit)."""
+
+    def __init__(self, max_len, init="uniform", input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.max_len = int(max_len)
+        self.init_name = init
+
+    def init_params(self, rng, input_shape):
+        d_model = input_shape[-1]
+        table = initializers.get(self.init_name)(
+            rng, (self.max_len, d_model))
+        return {"table": table * 0.02 if self.init_name == "uniform"
+                else table}
+
+    def call(self, params, state, inputs, training=False, rng=None):
+        s = inputs.shape[-2]
+        if s > self.max_len:
+            raise ValueError(
+                f"sequence length {s} exceeds max_len {self.max_len}")
+        return inputs + params["table"][:s].astype(inputs.dtype)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg.update(max_len=self.max_len, init=self.init_name)
+        return cfg

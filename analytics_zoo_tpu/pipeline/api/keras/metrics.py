@@ -173,7 +173,9 @@ class Loss(Metric):
         return {"sum": jnp.zeros(()), "total": jnp.zeros(())}
 
     def update(self, acc, y_true, y_pred, mask=None):
-        per_sample = self.loss_fn(y_true, y_pred)
+        from .objectives import _batch_mean
+        # per-position sequence losses collapse to per-sample
+        per_sample = _batch_mean(self.loss_fn(y_true, y_pred))
         w = _sample_mask(mask, per_sample.shape[0])
         # masked-out padded samples may be NaN (e.g. out-of-range label
         # guards on zero-padding); NaN * 0 is NaN, so zero them first

@@ -57,11 +57,21 @@ def categorical_crossentropy(y_true, y_pred):
     return -jnp.sum(y_true * jnp.log(p), axis=-1)
 
 
-def sparse_categorical_crossentropy(y_true, y_pred):
-    """y_true int labels (zero-based), y_pred probabilities."""
-    labels = jnp.squeeze(y_true).astype(jnp.int32)
+def _align_labels(y_true, y_pred):
+    """Labels shaped ``y_pred.shape[:-1]``: squeeze ONLY a trailing
+    singleton class axis — a full ``jnp.squeeze`` would collapse a
+    batch_size=1 or seq_len=1 axis of sequence targets (b, S)."""
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == y_pred.ndim and labels.shape[-1] == 1:
+        labels = labels.squeeze(-1)
     if labels.ndim == 0:
         labels = labels[None]
+    return labels
+
+
+def sparse_categorical_crossentropy(y_true, y_pred):
+    """y_true int labels (zero-based), y_pred probabilities."""
+    labels = _align_labels(y_true, y_pred)
     p = jnp.clip(y_pred, EPS, 1.0)
     logp = jnp.log(p)
     return _guarded_label_pick(logp, labels)
@@ -101,9 +111,7 @@ def class_nll(y_true, y_pred, zero_based_label=True):
     metrics' parameter of the same name.  Out-of-range labels under
     either convention produce NaN loss rather than silently clamping.
     """
-    labels = jnp.squeeze(y_true).astype(jnp.int32)
-    if labels.ndim == 0:
-        labels = labels[None]
+    labels = _align_labels(y_true, y_pred)
     if not zero_based_label:
         labels = labels - 1
     return _guarded_label_pick(y_pred, labels)

@@ -252,7 +252,11 @@ class Trainer:
                 # include auxiliary penalties (regularizers / MoE aux)
                 # per sample so the reported evaluate loss is comparable
                 # with the training loss (Keras includes them too)
-                per_sample = loss_fn(y, y_pred) + _collect_aux(eval_state)
+                from ..pipeline.api.keras.objectives import _batch_mean
+                # sequence losses arrive per-position (batch, T, ...):
+                # collapse to per-SAMPLE so masking stays (batch,)
+                per_sample = _batch_mean(
+                    loss_fn(y, y_pred) + _collect_aux(eval_state))
                 w = mask.reshape(-1).astype(jnp.float32)
                 # neutralize masked-out padding BEFORE weighting: padded
                 # tail samples can legitimately be NaN (e.g. class_nll's

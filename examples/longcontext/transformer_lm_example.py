@@ -1,0 +1,63 @@
+"""TransformerLM end to end: train the zoo's decoder-only LM on a
+character-level copy task and sample from it.
+
+Shows the long-context stack working together: Embedding +
+PositionalEmbedding -> pre-norm MultiHeadSelfAttention blocks (causal;
+pallas flash kernel on TPU via the transpose-free bhsd projection) ->
+log-softmax head trained with class_nll on next-token targets.
+
+Run (CPU): JAX_PLATFORMS=cpu python transformer_lm_example.py
+"""
+
+import argparse
+
+import numpy as np
+
+
+def char_dataset(n_seqs, seq_len, vocab, seed=0):
+    """Periodic integer sequences — deterministic next-token structure
+    a causal LM can learn quickly."""
+    rng = np.random.default_rng(seed)
+    step = rng.integers(1, 5, n_seqs)
+    start = rng.integers(0, vocab, n_seqs)
+    toks = (start[:, None]
+            + step[:, None] * np.arange(seq_len + 1)[None, :]) % vocab
+    return toks[:, :-1].astype(np.int32), toks[:, 1:].astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=16)
+    args = ap.parse_args()
+
+    from analytics_zoo_tpu.common import init_nncontext
+    from analytics_zoo_tpu.models import TransformerLM
+
+    init_nncontext("TransformerLM example")
+    x, y = char_dataset(512, args.seq_len, args.vocab)
+
+    lm = TransformerLM(vocab_size=args.vocab, seq_len=args.seq_len,
+                       n_layers=2, d_model=64, n_heads=4)
+    lm.compile(optimizer={"name": "adam", "lr": 3e-3}, loss="class_nll",
+               metrics=["accuracy"])
+    lm.fit(x, y, batch_size=64, nb_epoch=args.epochs)
+    res = lm.evaluate(x, y, batch_size=64)
+    print(f"next-token accuracy: {res['accuracy']:.3f} "
+          f"(unigram floor ~{1 / args.vocab:.3f})")
+
+    # greedy generation: feed a prefix, roll the argmax forward
+    ctx = x[:1].copy()
+    generated = []
+    for _ in range(12):
+        logp = np.asarray(lm.predict(ctx, batch_size=1))
+        nxt = int(np.argmax(logp[0, -1]))
+        generated.append(nxt)
+        ctx = np.concatenate([ctx[:, 1:], [[nxt]]], axis=1).astype(np.int32)
+    print("greedy continuation:", generated)
+    print("transformer lm example done")
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,13 @@ class TestExamples:
             "--seq-len", "1024")
         assert "ring attention OK: seq 1024 split 8 ways" in out
 
+    def test_transformer_lm_example(self):
+        out = run_example(
+            "examples/longcontext/transformer_lm_example.py",
+            "--epochs", "3", "--seq-len", "16")
+        assert "transformer lm example done" in out
+        assert "next-token accuracy" in out
+
     def test_lenet_train_then_evaluate(self, tmp_path):
         ckpt = str(tmp_path / "ckpt")
         run_example("examples/lenet/train_lenet.py", "--epochs", "1",

@@ -1,0 +1,146 @@
+"""TransformerLM + MultiHeadSelfAttention/PositionalEmbedding layers —
+the long-context flagship (TPU-era extension; SURVEY §5 notes the
+reference has no attention, the task brief makes it first-class)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.models import TransformerLM
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    MultiHeadSelfAttention, PositionalEmbedding)
+from analytics_zoo_tpu.ops.attention import attention_bhsd, naive_attention
+
+
+def test_attention_bhsd_dispatch_matches_naive():
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 2, 64, 16)),
+                           jnp.float32) for _ in range(3))
+    ref = naive_attention(*(a.transpose(0, 2, 1, 3) for a in (q, k, v)),
+                          causal=True)
+    for impl in ("auto", "blockwise", "naive", "flash"):
+        out = attention_bhsd(q, k, v, causal=True, implementation=impl)
+        np.testing.assert_allclose(
+            np.asarray(out.transpose(0, 2, 1, 3)), np.asarray(ref),
+            rtol=2e-4, atol=2e-5, err_msg=impl)
+
+
+def test_mhsa_layer_causality():
+    """Output at position t must not depend on tokens after t."""
+    zoo.init_nncontext()
+    layer = MultiHeadSelfAttention(2, causal=True, input_shape=(16, 8),
+                                   implementation="naive")
+    params = layer.init_params(jax.random.PRNGKey(0), (1, 16, 8))
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(1, 16, 8)),
+                    jnp.float32)
+    base = np.asarray(layer.call(params, {}, x))
+    x2 = x.at[0, 10:].set(99.0)       # mutate the future
+    out2 = np.asarray(layer.call(params, {}, x2))
+    np.testing.assert_allclose(out2[0, :10], base[0, :10], rtol=1e-4,
+                               atol=1e-5)
+    assert not np.allclose(out2[0, 10:], base[0, 10:])
+
+
+def test_positional_embedding_slices_and_bounds():
+    layer = PositionalEmbedding(max_len=32, input_shape=(8, 4))
+    params = layer.init_params(jax.random.PRNGKey(0), (2, 8, 4))
+    x = jnp.zeros((2, 8, 4))
+    out = layer.call(params, {}, x)
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               np.asarray(params["table"][:8]), rtol=1e-6)
+    with pytest.raises(ValueError, match="max_len"):
+        layer.call(params, {}, jnp.zeros((1, 64, 4)))
+
+
+def test_transformer_lm_trains_on_induction_toy():
+    """Next-token prediction on a repeating pattern: the causal LM must
+    beat the unigram floor by a wide margin after a few epochs."""
+    zoo.init_nncontext()
+    rng = np.random.default_rng(0)
+    vocab, seq, n = 12, 24, 256
+    # periodic sequences: token[t] = (token[t-1] + step) % vocab, step
+    # fixed per sequence -> perfectly predictable from context
+    steps = rng.integers(1, 4, n)
+    start = rng.integers(0, vocab, n)
+    toks = (start[:, None] + steps[:, None]
+            * np.arange(seq + 1)[None, :]) % vocab
+    x = toks[:, :-1].astype(np.int32)
+    y = toks[:, 1:].astype(np.int32)
+
+    lm = TransformerLM(vocab_size=vocab, seq_len=seq, n_layers=2,
+                       d_model=32, n_heads=2)
+    lm.compile(optimizer={"name": "adam", "lr": 3e-3}, loss="class_nll",
+               metrics=["accuracy"])
+    hist = lm.fit(x, y, batch_size=32, nb_epoch=12)
+    assert np.isfinite(hist["loss"]).all()
+    res = lm.evaluate(x, y, batch_size=32)
+    # unigram floor ~= 1/vocab = 0.083; the pattern is deterministic
+    assert res["accuracy"] > 0.5, res
+    # log-softmax head: per-position probs sum to 1
+    probs = np.exp(np.asarray(lm.predict(x[:4], batch_size=4)))
+    np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-3)
+
+
+def test_class_nll_sequence_targets_batch_one():
+    """Code-review r4: jnp.squeeze used to collapse (1, S) sequence
+    targets; class_nll must handle batch_size=1 and (b, S, 1) shapes."""
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+    logp = jnp.log(jnp.full((1, 3, 4), 0.25))
+    y = jnp.asarray([[0, 1, 2]], jnp.int32)              # (1, S)
+    out = objectives.class_nll(y, logp)
+    assert out.shape == (1, 3)
+    np.testing.assert_allclose(np.asarray(out), -np.log(0.25), rtol=1e-6)
+    out2 = objectives.class_nll(y[..., None], logp)      # (1, S, 1)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out))
+    # seq_len=1 likewise
+    out3 = objectives.sparse_categorical_crossentropy(
+        jnp.asarray([[1]], jnp.int32), jnp.full((1, 1, 4), 0.25))
+    assert out3.shape == (1, 1) and np.isfinite(np.asarray(out3)).all()
+
+
+def test_attention_bhsd_explicit_flash_raises_on_bad_divisor():
+    """Explicit implementation='flash' with a prime-ish sequence must
+    raise, never silently fall back to O(S^2) naive."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 2, 7, 16)), jnp.float32)
+    with pytest.raises(ValueError, match="block divisor"):
+        attention_bhsd(q, q, q, causal=True, implementation="flash")
+    # auto on CPU with the same shape quietly uses naive (correct path)
+    out = attention_bhsd(q, q, q, causal=True)
+    assert out.shape == q.shape
+
+
+def test_transformer_lm_save_load_roundtrip(tmp_path):
+    zoo.init_nncontext()
+    lm = TransformerLM(vocab_size=16, seq_len=8, n_layers=1, d_model=16,
+                       n_heads=2)
+    lm.compile(optimizer="adam", loss="class_nll")
+    x = np.random.default_rng(0).integers(0, 16, (8, 8)).astype(np.int32)
+    y = np.random.default_rng(1).integers(0, 16, (8, 8)).astype(np.int32)
+    lm.fit(x, y, batch_size=8, nb_epoch=1)
+    ref = np.asarray(lm.predict(x, batch_size=8))
+    path = str(tmp_path / "lm.zoo")
+    lm.save_model(path)
+    from analytics_zoo_tpu.pipeline.api.keras import load_model
+    lm2 = load_model(path)
+    np.testing.assert_allclose(np.asarray(lm2.predict(x, batch_size=8)),
+                               ref, rtol=1e-5, atol=1e-6)
+
+
+def test_transformer_lm_shards_over_mesh():
+    """The LM's training step compiles and runs under tensor-parallel +
+    data-parallel sharding on the 8-device CPU mesh."""
+    from analytics_zoo_tpu.parallel import create_mesh
+    zoo.reset_nncontext()
+    zoo.init_nncontext()
+    mesh = create_mesh({"data": 4, "model": 2})
+    lm = TransformerLM(vocab_size=16, seq_len=16, n_layers=1,
+                       d_model=32, n_heads=2)
+    lm.compile(optimizer="adam", loss="class_nll", mesh=mesh,
+               strategy="tensor")
+    x = np.random.default_rng(0).integers(0, 16, (16, 16)).astype(np.int32)
+    y = np.random.default_rng(1).integers(0, 16, (16, 16)).astype(np.int32)
+    hist = lm.fit(x, y, batch_size=8, nb_epoch=1)
+    assert np.isfinite(hist["loss"]).all()
