@@ -76,11 +76,38 @@ def dp_size(mesh: Mesh) -> int:
 
 
 _DEFAULT_MESH: Optional[Mesh] = None
+_ACTIVE_MESH: Optional[Mesh] = None
 
 
 def set_default_mesh(mesh: Optional[Mesh]):
     global _DEFAULT_MESH
     _DEFAULT_MESH = mesh
+
+
+class active_mesh:
+    """Context manager marking the mesh a Trainer is tracing/executing
+    under, so mesh-aware layers (ring attention) see the mesh passed to
+    ``compile(mesh=...)`` rather than only the process default."""
+
+    def __init__(self, mesh: Optional[Mesh]):
+        self.mesh = mesh
+
+    def __enter__(self):
+        global _ACTIVE_MESH
+        self._prev = _ACTIVE_MESH
+        _ACTIVE_MESH = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        global _ACTIVE_MESH
+        _ACTIVE_MESH = self._prev
+        return False
+
+
+def get_active_mesh() -> Optional[Mesh]:
+    """The mesh of the currently-executing Trainer (if inside one),
+    else the process default — WITHOUT auto-creating one."""
+    return _ACTIVE_MESH if _ACTIVE_MESH is not None else _DEFAULT_MESH
 
 
 def get_default_mesh() -> Mesh:

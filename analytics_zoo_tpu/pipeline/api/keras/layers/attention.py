@@ -29,7 +29,12 @@ class MultiHeadSelfAttention(Layer):
     - ``n_heads`` × ``head_dim`` (default ``d_model // n_heads``)
     - ``causal=True`` masks future positions (decoder-style)
     - ``implementation``: "auto" (pallas flash kernel on TPU, blockwise
-      XLA elsewhere), "flash", "blockwise", or "naive"
+      XLA elsewhere), "flash", "blockwise", "naive", or "ring" —
+      sequence-parallel ring attention over the mesh's ``seq`` axis
+      (``parallel/ring_attention``): activations stay sharded along the
+      sequence, KV blocks rotate around the ring, so contexts beyond
+      one chip's memory train like any other layer.  Requires the
+      active mesh to carry a ``seq`` axis.
     """
 
     def __init__(self, n_heads, head_dim=None, causal=True,
@@ -65,6 +70,29 @@ class MultiHeadSelfAttention(Layer):
         }
 
     def call(self, params, state, inputs, training=False, rng=None):
+        if self.implementation == "ring":
+            # sequence parallelism: project into the ring kernel's
+            # (b, s, h, d) contract — still a pure einsum, no transpose
+            from .....parallel.mesh import get_active_mesh
+            from .....parallel.ring_attention import ring_attention_sharded
+            # the ACTIVE mesh: the one compile(mesh=...) handed the
+            # Trainer (set around every step trace/call), falling back
+            # to the process default
+            mesh = get_active_mesh()
+            if mesh is None or "seq" not in mesh.axis_names:
+                raise ValueError(
+                    "implementation='ring' needs the active mesh to "
+                    "carry a 'seq' axis (create_mesh({'seq': n, ...}))")
+            seq_size = mesh.shape["seq"]
+            if inputs.shape[-2] % seq_size:
+                raise ValueError(
+                    f"sequence length {inputs.shape[-2]} is not "
+                    f"divisible by the mesh's seq axis ({seq_size})")
+            q = jnp.einsum("bse,ehd->bshd", inputs, params["Wq"])
+            k = jnp.einsum("bse,ehd->bshd", inputs, params["Wk"])
+            v = jnp.einsum("bse,ehd->bshd", inputs, params["Wv"])
+            o = ring_attention_sharded(q, k, v, mesh, causal=self.causal)
+            return jnp.einsum("bshd,hde->bse", o, params["Wo"])
         # project straight into (b, h, s, d) — layout rides the matmul
         q = jnp.einsum("bse,ehd->bhsd", inputs, params["Wq"])
         k = jnp.einsum("bse,ehd->bhsd", inputs, params["Wk"])

@@ -234,6 +234,17 @@ class Trainer:
             self.state.opt_state = self.optimizer.init(placed)
 
     # ------------------------------------------------------------------
+    def _mesh_scoped(self, fn):
+        """Wrap a (possibly jitted) step so every call — including the
+        trace-triggering first one — runs under this trainer's mesh as
+        the ACTIVE mesh, letting mesh-aware layers (ring attention)
+        discover the compile(mesh=...) mesh instead of only the
+        process default."""
+        def wrapped(*a, **k):
+            with mesh_lib.active_mesh(self.mesh):
+                return fn(*a, **k)
+        return wrapped
+
     def _build_train_step(self):
         return build_train_step(self.model, self.loss_fn, self.optimizer,
                                 compute_dtype=self.compute_dtype)
@@ -362,7 +373,8 @@ class Trainer:
         net.py:458-468); single-process it is the whole batch."""
         self.ensure_initialized()
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step = self._mesh_scoped(
+                self._build_train_step())
         check_batch_divisibility(batch_size, mesh_lib.dp_size(self.mesh),
                                  dist_lib.process_count())
         per_host_bs = batch_size // dist_lib.process_count()
@@ -512,7 +524,8 @@ class Trainer:
         if metrics is None:
             use_metrics = self.metrics
             if self._eval_step is None:
-                self._eval_step = self._build_eval_step()
+                self._eval_step = self._mesh_scoped(
+                    self._build_eval_step())
             eval_step = self._eval_step
         else:
             from ..pipeline.api.keras import metrics as metrics_lib
@@ -535,7 +548,9 @@ class Trainer:
             key = tuple(_metric_key(m) for m in use_metrics)
             if self._eval_step_overrides.get("key") != key:
                 self._eval_step_overrides = {
-                    "key": key, "step": self._build_eval_step(use_metrics)}
+                    "key": key,
+                    "step": self._mesh_scoped(
+                        self._build_eval_step(use_metrics))}
             eval_step = self._eval_step_overrides["step"]
         accs = [m.init() for m in use_metrics]
         loss_acc = {"sum": jnp.zeros(()), "n": jnp.zeros(())}
@@ -603,7 +618,8 @@ class Trainer:
         reference's partition-local predict, Topology.scala:393-397)."""
         self.ensure_initialized()
         if self._predict_step is None:
-            self._predict_step = self._build_predict_step()
+            self._predict_step = self._mesh_scoped(
+                self._build_predict_step())
         if isinstance(dataset_or_x, Dataset):
             ds = dataset_or_x
         else:
