@@ -20,7 +20,7 @@ from __future__ import annotations
 from ..pipeline.api.keras.engine import Model
 from ..pipeline.api.keras.layers import (
     Activation, Dense, Dropout, Embedding, Input, LayerNorm, Merge,
-    MultiHeadSelfAttention, PositionalEmbedding)
+    MultiHeadSelfAttention, PositionalEmbedding, SwitchMoE)
 from .common import ZooModel, register_zoo_model
 
 
@@ -36,7 +36,15 @@ class TransformerLM(ZooModel):
             (``d_ff`` defaults to ``4 * d_model``).
         dropout: residual-path dropout probability.
         implementation: attention implementation forwarded to
-            :class:`MultiHeadSelfAttention`.
+            :class:`MultiHeadSelfAttention` (incl. ``"ring"`` for
+            sequence parallelism over a ``seq`` mesh axis).
+        moe_every: replace every k-th MLP with a :class:`SwitchMoE`
+            FFN (``n_experts`` experts, pre-norm, router aux loss
+            auto-wired — the Switch-transformer shape).  ``None``
+            (default) keeps all-dense MLPs.  The layer runs the
+            single-device formulation (expert params replicated under
+            data parallelism); explicit expert-axis sharding is
+            ``parallel.moe_sharded``.
 
     Output: (batch, seq_len, vocab_size) LOG-probabilities — compile
     with ``loss="class_nll"`` and next-token int targets of shape
@@ -45,12 +53,14 @@ class TransformerLM(ZooModel):
 
     def __init__(self, vocab_size=None, seq_len=128, n_layers=2,
                  d_model=128, n_heads=4, d_ff=None, max_len=None,
-                 dropout=0.0, implementation="auto", name=None, **kw):
+                 dropout=0.0, implementation="auto", moe_every=None,
+                 n_experts=8, name=None, **kw):
         super().__init__(
             name=name, vocab_size=vocab_size, seq_len=seq_len,
             n_layers=n_layers, d_model=d_model, n_heads=n_heads,
             d_ff=d_ff or 4 * d_model, max_len=max_len or seq_len,
-            dropout=dropout, implementation=implementation, **kw)
+            dropout=dropout, implementation=implementation,
+            moe_every=moe_every, n_experts=n_experts, **kw)
 
     def build_model(self) -> Model:
         h = self.hyper
@@ -67,10 +77,20 @@ class TransformerLM(ZooModel):
             if h["dropout"]:
                 a = Dropout(h["dropout"])(a)
             x = Merge(mode="sum")([x, a])
+            moe = (h["moe_every"]
+                   and (i + 1) % h["moe_every"] == 0)
             f = LayerNorm(name=f"ln_mlp_{i}")(x)
-            f = Dense(h["d_ff"], activation="gelu",
-                      name=f"mlp_up_{i}")(f)
-            f = Dense(h["d_model"], name=f"mlp_down_{i}")(f)
+            if moe:
+                # pre-norm MoE sublayer, composed exactly like the
+                # dense MLP (Switch Transformer applies LN before the
+                # MoE FFN); aux loss auto-wired through layer state
+                f = SwitchMoE(n_experts=h["n_experts"],
+                              hidden_dim=h["d_ff"], residual=False,
+                              name=f"moe_{i}")(f)
+            else:
+                f = Dense(h["d_ff"], activation="gelu",
+                          name=f"mlp_up_{i}")(f)
+                f = Dense(h["d_model"], name=f"mlp_down_{i}")(f)
             if h["dropout"]:
                 f = Dropout(h["dropout"])(f)
             x = Merge(mode="sum")([x, f])

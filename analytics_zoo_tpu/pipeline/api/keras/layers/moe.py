@@ -33,7 +33,7 @@ class SwitchMoE(Layer):
 
     def __init__(self, n_experts: int = 8, hidden_dim: int = None,
                  capacity_factor: float = 1.25, aux_weight: float = 0.01,
-                 input_shape=None, name=None):
+                 residual: bool = True, input_shape=None, name=None):
         super().__init__(input_shape=input_shape, name=name)
         self.n_experts = int(n_experts)
         self.hidden_dim = hidden_dim
@@ -41,6 +41,11 @@ class SwitchMoE(Layer):
         # the Switch paper's load-balancing coefficient; the trainer sums
         # every layer's state["aux_loss"] into the training loss
         self.aux_weight = float(aux_weight)
+        # residual=False emits bare MoE(x) so pre-norm stacks can
+        # compose LN -> MoE -> Dropout -> Merge like any other sublayer
+        # (capacity-dropped tokens then contribute zero, which the
+        # OUTER residual passes through unchanged — same semantics)
+        self.residual = bool(residual)
 
     def _dims(self, input_shape):
         d = input_shape[-1]
@@ -63,7 +68,9 @@ class SwitchMoE(Layer):
         cap = expert_capacity(flat.shape[0], self.n_experts,
                               self.capacity_factor)
         out, aux = switch_moe(flat, p, capacity=cap)
-        y = inputs + out.reshape(inputs.shape)
+        y = out.reshape(inputs.shape)
+        if self.residual:
+            y = inputs + y
         return y, {"aux_loss": self.aux_weight * aux}
 
     def compute_output_shape(self, input_shape):
@@ -73,5 +80,5 @@ class SwitchMoE(Layer):
         cfg = super().get_config()
         cfg.update(n_experts=self.n_experts, hidden_dim=self.hidden_dim,
                    capacity_factor=self.capacity_factor,
-                   aux_weight=self.aux_weight)
+                   aux_weight=self.aux_weight, residual=self.residual)
         return cfg

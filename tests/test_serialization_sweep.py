@@ -118,6 +118,13 @@ CASES = {
                        (4, 4, 3), None),
     "SwitchMoE": (lambda s: L.SwitchMoE(n_experts=4, hidden_dim=8,
                                         input_shape=s), (6,), None),
+    "MultiHeadSelfAttention": (
+        lambda s: L.MultiHeadSelfAttention(2, causal=True,
+                                           implementation="naive",
+                                           input_shape=s), (8, 12), None),
+    "PositionalEmbedding": (
+        lambda s: L.PositionalEmbedding(max_len=16, input_shape=s),
+        (8, 6), None),
     "ResizeBilinear": (
         lambda s: L.ResizeBilinear(output_height=6, output_width=7,
                                    input_shape=s), (4, 5, 2), None),

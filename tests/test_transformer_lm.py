@@ -112,6 +112,32 @@ def test_attention_bhsd_explicit_flash_raises_on_bad_divisor():
     assert out.shape == q.shape
 
 
+def test_transformer_lm_moe_variant_trains():
+    """moe_every: Switch-MoE MLPs slot into the block stack; the router
+    aux loss reaches training (finite loss, model still learns)."""
+    zoo.reset_nncontext()
+    zoo.init_nncontext()
+    rng = np.random.default_rng(0)
+    vocab, seq = 12, 16
+    steps = rng.integers(1, 3, 128)
+    start = rng.integers(0, vocab, 128)
+    toks = (start[:, None] + steps[:, None]
+            * np.arange(seq + 1)[None, :]) % vocab
+    x, y = toks[:, :-1].astype(np.int32), toks[:, 1:].astype(np.int32)
+    lm = TransformerLM(vocab_size=vocab, seq_len=seq, n_layers=2,
+                       d_model=32, n_heads=2, moe_every=2, n_experts=4)
+    lm.compile(optimizer={"name": "adam", "lr": 3e-3}, loss="class_nll",
+               metrics=["accuracy"])
+    hist = lm.fit(x, y, batch_size=32, nb_epoch=8)
+    assert np.isfinite(hist["loss"]).all()
+    assert hist["loss"][-1] < hist["loss"][0] * 0.8
+    res = lm.evaluate(x, y, batch_size=32)
+    assert res["accuracy"] > 0.3, res
+    # the MoE layer actually exists in the graph
+    assert any("moe" in getattr(v.layer, "name", "")
+               for v in lm.to_graph().nodes)
+
+
 def test_transformer_lm_save_load_roundtrip(tmp_path):
     zoo.init_nncontext()
     lm = TransformerLM(vocab_size=16, seq_len=8, n_layers=1, d_model=16,
