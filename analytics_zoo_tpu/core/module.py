@@ -156,11 +156,20 @@ class Layer:
         cfg = {"name": self.name}
         if self.batch_input_shape is not None:
             cfg["input_shape"] = list(self.batch_input_shape[1:])
+        if not self.trainable:
+            # persist freezes (fine-tuned models reload still frozen);
+            # omitted when True so existing configs stay byte-stable
+            cfg["trainable"] = False
         return cfg
 
     @classmethod
     def from_config(cls, config: dict) -> "Layer":
-        return cls(**config)
+        config = dict(config)
+        # handled here because subclass __init__s don't take **kwargs
+        trainable = config.pop("trainable", True)
+        obj = cls(**config)
+        obj.trainable = trainable
+        return obj
 
     def __repr__(self):
         return f"<{type(self).__name__} {self.name}>"

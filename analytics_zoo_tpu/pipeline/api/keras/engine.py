@@ -103,6 +103,76 @@ class KerasNet(Layer):
         self._inference_only = False
         return self
 
+    # ---- freeze / unfreeze (reference GraphNet freeze_up_to/unfreeze,
+    # pyzoo net.py:85-104).  SINGLE source of truth: ``layer.trainable``
+    # flags (the same flags GraphNet and the graph's stop_gradient path
+    # use).  The Trainer derives an optimizer mask from the flags —
+    # frozen layers receive EXACTLY zero updates (stop_gradient alone
+    # would leave stateful optimizers moving them on stale momentum) —
+    # and refreshes in place: weights and epoch/step counters survive,
+    # optimizer statistics reset. ----
+    def _layers_by_name(self):
+        out = {}
+        for v in self.to_graph().nodes:
+            if v.layer is not None:
+                out.setdefault(v.layer.name, v.layer)
+        return out
+
+    def _resolve_layer_names(self, names):
+        if isinstance(names, str):
+            names = [names]
+        known = self._layers_by_name()
+        unknown = [n for n in names if n not in known]
+        if unknown:
+            raise ValueError(f"unknown layer names {unknown}; known: "
+                             f"{sorted(known)}")
+        return list(names), known
+
+    def _sync_freeze(self):
+        if self.trainer is not None:
+            self.trainer.refresh_optimizer()
+        return self
+
+    def freeze(self, names):
+        """Freeze the named layers (zero weight updates in training) —
+        reference ``freeze`` semantics; takes effect immediately."""
+        names, known = self._resolve_layer_names(names)
+        for n in names:
+            known[n].trainable = False
+        return self._sync_freeze()
+
+    def freeze_up_to(self, names):
+        """Freeze every layer from the inputs up to (inclusive) the
+        named layers — ANCESTORS only, parallel branches stay trainable
+        (reference ``freeze_up_to`` / NetUtils.scala:216-277)."""
+        names, _ = self._resolve_layer_names(names)
+        graph = self.to_graph()
+        targets = [v for v in graph.nodes
+                   if v.layer is not None and v.layer.name in names]
+        from ....core.graph import InputLayer
+        for t in targets:
+            for v in t.ancestors():
+                if v.layer is not None and not isinstance(v.layer,
+                                                          InputLayer):
+                    v.layer.trainable = False
+        return self._sync_freeze()
+
+    def unfreeze(self, names=None):
+        """Unfreeze the named layers (all when ``names`` is None) —
+        reference ``unfreeze``."""
+        if names is None:
+            for layer in self._layers_by_name().values():
+                layer.trainable = True
+        else:
+            names, known = self._resolve_layer_names(names)
+            for n in names:
+                known[n].trainable = True
+        return self._sync_freeze()
+
+    def frozen_layer_names(self) -> List[str]:
+        return sorted(n for n, l in self._layers_by_name().items()
+                      if not l.trainable)
+
     def ensure_inference_ready(self) -> Trainer:
         """Attach an inference-only trainer when the model was never
         compiled (reference predict works on a bare loaded model).  Does

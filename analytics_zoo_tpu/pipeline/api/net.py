@@ -164,12 +164,12 @@ class GraphNet(Model):
         for v in self._graph.nodes:
             if v.node_id in frozen_ids and v.layer is not None:
                 v.layer.trainable = False
-        return self
+        return self._sync_freeze()
 
     def unfreeze(self) -> "GraphNet":
         for layer in self._graph.layers:
             layer.trainable = True
-        return self
+        return self._sync_freeze()
 
     def frozen_layer_names(self) -> List[str]:
         return [l.name for l in self._graph.layers if not l.trainable]

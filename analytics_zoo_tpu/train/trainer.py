@@ -146,7 +146,10 @@ class Trainer:
         transformation."""
         self.model = model
         self.loss_fn = loss_fn
-        self.optimizer = optimizer
+        # the optimizer actually stepped is the base masked by the
+        # model's layer.trainable flags (freeze/unfreeze support)
+        self._base_optimizer = optimizer
+        self.optimizer = self._mask_from_flags(optimizer)
         self.metrics = list(metrics)
         self.mesh = mesh or mesh_lib.get_default_mesh()
         self.strategy = strategy
@@ -162,6 +165,49 @@ class Trainer:
         self._param_shardings = None
         self._batch_sharding = mesh_lib.data_sharding(self.mesh)
         self._repl_sharding = mesh_lib.replicated(self.mesh)
+
+    # ---- freeze support --------------------------------------------
+    def _frozen_names(self) -> set:
+        return {l.name for l in getattr(self.model, "layers", [])
+                if not getattr(l, "trainable", True)}
+
+    def _mask_from_flags(self, base):
+        """Wrap ``base`` so layers with ``trainable=False`` receive
+        EXACTLY zero updates (optax.set_to_zero routing — stop_gradient
+        alone leaves stateful optimizers moving frozen weights on stale
+        momentum)."""
+        frozen = self._frozen_names()
+        if not frozen:
+            return base
+
+        def labels(params):
+            return {k: jax.tree_util.tree_map(
+                        lambda _: ("frozen" if k in frozen
+                                   else "trainable"), sub)
+                    for k, sub in params.items()}
+
+        return optax.multi_transform(
+            {"trainable": base, "frozen": optax.set_to_zero()}, labels)
+
+    def invalidate_compiled(self):
+        """Drop the compiled step functions (they re-trace lazily) —
+        TrainState (weights, optimizer state, epoch/step counters)
+        survives."""
+        self._train_step = None
+        self._eval_step = None
+        self._eval_step_overrides = {}
+        self._predict_step = None
+
+    def refresh_optimizer(self):
+        """Re-derive the optimizer mask from the model's current
+        trainable flags and re-initialize optimizer STATISTICS from the
+        placed params (weights and epoch/step counters are preserved;
+        moments reset — stale momentum must not keep moving
+        freshly-frozen weights)."""
+        self.optimizer = self._mask_from_flags(self._base_optimizer)
+        if self.state is not None:
+            self.state.opt_state = self.optimizer.init(self.state.params)
+        self.invalidate_compiled()
 
     # ------------------------------------------------------------------
     def ensure_initialized(self):

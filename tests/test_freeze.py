@@ -1,0 +1,124 @@
+"""freeze / freeze_up_to / unfreeze — reference GraphNet parity
+(pyzoo net.py:85-104).  Single source of truth: layer.trainable flags;
+the Trainer masks the optimizer from the flags (exact zero updates,
+even under stateful optimizers) and refreshes in place."""
+
+import numpy as np
+import pytest
+import jax
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.pipeline.api.keras import (Model, Sequential,
+                                                  load_model)
+from analytics_zoo_tpu.pipeline.api.keras.layers import (Dense, Input,
+                                                         Merge)
+
+
+def _model():
+    m = Sequential()
+    m.add(Dense(8, input_shape=(4,), activation="relu", name="backbone1"))
+    m.add(Dense(8, activation="relu", name="backbone2"))
+    m.add(Dense(2, name="head"))
+    return m
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = rng.normal(size=(64, 2)).astype(np.float32)
+    return x, y
+
+
+def _weights(m):
+    return {k: {kk: np.asarray(vv) for kk, vv in v.items()}
+            for k, v in jax.device_get(m.get_weights()).items()}
+
+
+def test_freeze_up_to_trains_only_the_head():
+    zoo.init_nncontext()
+    m = _model()
+    m.compile("sgd", "mse")
+    x, y = _data()
+    m.fit(x, y, batch_size=32, nb_epoch=1)
+    m.freeze_up_to(["backbone2"])
+    assert m.frozen_layer_names() == ["backbone1", "backbone2"]
+    before = _weights(m)
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    after = _weights(m)
+    for name in ("backbone1", "backbone2"):
+        np.testing.assert_array_equal(after[name]["W"], before[name]["W"],
+                                      err_msg=name)
+    assert not np.allclose(after["head"]["W"], before["head"]["W"])
+    # the trainer survived the freeze: epoch counter kept counting
+    assert m.trainer.state.epoch == 3
+
+    m.unfreeze()
+    assert m.frozen_layer_names() == []
+    before = _weights(m)
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    after = _weights(m)
+    assert not np.allclose(after["backbone1"]["W"], before["backbone1"]["W"])
+
+
+def test_freeze_exact_zero_updates_under_adam():
+    """Stateful optimizer: stop_gradient alone would keep moving frozen
+    weights on stale momentum — the optimizer mask must give EXACTLY
+    zero updates from the first post-freeze step."""
+    zoo.init_nncontext()
+    m = _model()
+    m.compile("adam", "mse")
+    x, y = _data()
+    m.fit(x, y, batch_size=32, nb_epoch=3)     # build up adam moments
+    m.freeze("backbone2")
+    before = _weights(m)
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    after = _weights(m)
+    np.testing.assert_array_equal(after["backbone2"]["W"],
+                                  before["backbone2"]["W"])
+    assert not np.allclose(after["backbone1"]["W"], before["backbone1"]["W"])
+    assert not np.allclose(after["head"]["W"], before["head"]["W"])
+    with pytest.raises(ValueError, match="unknown layer"):
+        m.freeze("nope")
+    with pytest.raises(ValueError, match="unknown layer"):
+        m.freeze_up_to(["nope"])
+    m.unfreeze(["backbone2"])
+    before = _weights(m)
+    m.fit(x, y, batch_size=32, nb_epoch=1)
+    after = _weights(m)
+    assert not np.allclose(after["backbone2"]["W"], before["backbone2"]["W"])
+
+
+def test_freeze_up_to_spares_parallel_branches():
+    """Ancestor semantics: freezing up to one branch must not freeze a
+    parallel branch (code-review r4)."""
+    zoo.init_nncontext()
+    inp = Input(shape=(4,), name="fz_in")
+    b1 = Dense(8, activation="relu", name="fz_b1")(inp)
+    b2 = Dense(8, activation="relu", name="fz_b2")(b1)
+    c1 = Dense(8, activation="relu", name="fz_c1")(inp)
+    merged = Merge(mode="concat", concat_axis=-1)([b2, c1])
+    out = Dense(2, name="fz_head")(merged)
+    m = Model(input=inp, output=out)
+    m.freeze_up_to(["fz_b2"])
+    frozen = m.frozen_layer_names()
+    assert "fz_b1" in frozen and "fz_b2" in frozen
+    assert "fz_c1" not in frozen and "fz_head" not in frozen
+
+
+def test_freeze_persists_through_save_load(tmp_path):
+    zoo.init_nncontext()
+    m = _model()
+    m.compile("sgd", "mse")
+    x, y = _data()
+    m.fit(x, y, batch_size=32, nb_epoch=1)
+    m.freeze_up_to(["backbone1"])
+    path = str(tmp_path / "frozen.zoo")
+    m.save_model(path)
+    m2 = load_model(path)
+    assert m2.frozen_layer_names() == ["backbone1"]
+    before = _weights(m2)
+    m2.fit(x, y, batch_size=32, nb_epoch=2)
+    after = _weights(m2)
+    np.testing.assert_array_equal(after["backbone1"]["W"],
+                                  before["backbone1"]["W"])
+    assert not np.allclose(after["head"]["W"], before["head"]["W"])
