@@ -280,6 +280,16 @@ def child(platform: str):
     else:
         extras["ncf"] = {"skipped": "extras deadline"}
 
+    # ---- int8 vs f32 inference (wp-bigdl.md:192-196 headline claim) ----
+    if _extras_budget_left("int8_inference", 400):
+        try:
+            extras["int8_inference"] = _bench_int8(jax, jnp, np, on_tpu)
+        except Exception as e:
+            extras["int8_inference"] = {"error": f"{type(e).__name__}: {e}"}
+            _log(f"int8 bench failed: {e}")
+    else:
+        extras["int8_inference"] = {"skipped": "extras deadline"}
+
     # ---- TransformerLM training tokens/sec (long-context flagship;
     # exercises the transpose-free bhsd flash-attention path in a full
     # model rather than a microbench) ----
@@ -292,16 +302,6 @@ def child(platform: str):
             _log(f"transformer lm bench failed: {e}")
     else:
         extras["transformer_lm"] = {"skipped": "extras deadline"}
-
-    # ---- int8 vs f32 inference (wp-bigdl.md:192-196 headline claim) ----
-    if _extras_budget_left("int8_inference", 400):
-        try:
-            extras["int8_inference"] = _bench_int8(jax, jnp, np, on_tpu)
-        except Exception as e:
-            extras["int8_inference"] = {"error": f"{type(e).__name__}: {e}"}
-            _log(f"int8 bench failed: {e}")
-    else:
-        extras["int8_inference"] = {"skipped": "extras deadline"}
 
     baseline = 100.0  # nominal target (no published reference number)
     print(json.dumps({
@@ -728,7 +728,12 @@ def main():
     # the tunnel can hang outright, so attempts are time-boxed and the
     # last resort is a CPU measurement — a parsed value must always exist.
     if _probe_tpu():
-        plan = [("tpu", 1500, 20), ("tpu", 900, 0), ("cpu", 900, 0)]
+        # r4 added sections (bn_ab, input decomposition, second int8
+        # model, transformer_lm): a healthy-chip full plan costs ~2100s
+        # ACTUAL, but the section gates compare against conservative
+        # estimates — the box carries ~500s of gate headroom so a
+        # mildly-contended chip still reaches every section
+        plan = [("tpu", 2600, 20), ("tpu", 1200, 0), ("cpu", 900, 0)]
     else:
         # one cold-start-sized TPU attempt (the probe may have
         # false-negatived on a slow-but-alive chip), then CPU
