@@ -736,8 +736,11 @@ def main():
         plan = [("tpu", 2600, 20), ("tpu", 1200, 0), ("cpu", 900, 0)]
     else:
         # one cold-start-sized TPU attempt (the probe may have
-        # false-negatived on a slow-but-alive chip), then CPU
-        plan = [("tpu", 900, 10), ("cpu", 900, 0)]
+        # false-negatived on a slow-but-alive chip), then a CPU box
+        # sized for ALL sections (measured ~25-30 min on this host with
+        # the r4 additions) — a complete CPU artifact, not a truncated
+        # one, is what makes the outage legible (r3 precedent)
+        plan = [("tpu", 900, 10), ("cpu", 2100, 0)]
     last_fail = None
     for i, (platform, timeout, backoff) in enumerate(plan):
         _log(f"attempt {i + 1}/{len(plan)}: platform={platform} "
