@@ -112,8 +112,14 @@ def compare_strategies(mesh=None,
                   jax.tree_util.tree_map(sh_of, opt_state),
                   repl)
         jitted = jax.jit(step_fn, out_shardings=out_sh)
-        compiled = jitted.lower(params, state, opt_state, key, x,
-                                y).compile()
+        # trace under the REPORT's mesh as the active mesh so mesh-aware
+        # layers (SwitchMoE expert sharding, ring attention) take the
+        # same path here as they would under a Trainer compiled with
+        # this mesh — otherwise the report's collective counts could
+        # disagree with real training
+        with mesh_lib.active_mesh(mesh):
+            compiled = jitted.lower(params, state, opt_state, key, x,
+                                    y).compile()
         entry: Dict = {}
         try:
             entry["collectives"] = _collective_counts(compiled.as_text())

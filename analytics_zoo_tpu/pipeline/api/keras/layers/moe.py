@@ -3,9 +3,12 @@
 Extension scope (no reference analog — SURVEY §2.10: the reference is
 data-parallel only): wraps the functional switch-MoE block
 (``analytics_zoo_tpu.parallel.expert``) in the layer contract so
-Sequential/Model users get an MoE FFN with one ``add``.  Inside a model
-it runs the single-device formulation; for explicit expert-sharded
-execution over a mesh use ``parallel.moe_sharded`` directly.
+Sequential/Model users get an MoE FFN with one ``add``.  When the
+active mesh (the one ``compile(mesh=...)`` hands the trainer) carries
+an ``expert`` axis that divides the expert and token counts, the layer
+runs EXPERT-PARALLEL automatically (``moe_sharded``: experts sharded,
+tokens by all_to_all, per-shard capacity); otherwise it runs the
+single-device formulation with replicated experts.
 
 Input (batch, seq, d_model) or (batch, d_model); output the same shape
 with a residual connection (so capacity-dropped tokens pass through
@@ -22,7 +25,8 @@ import jax.numpy as jnp
 
 from .....core.module import Layer, register_layer
 from .....parallel.expert import (MoEParams, expert_capacity,
-                                  init_moe_params, switch_moe)
+                                  init_moe_params, moe_sharded,
+                                  switch_moe)
 
 
 @register_layer
@@ -65,9 +69,25 @@ class SwitchMoE(Layer):
         flat = inputs.reshape(-1, d)
         p = MoEParams(**{k: params[k]
                          for k in MoEParams._fields})
-        cap = expert_capacity(flat.shape[0], self.n_experts,
-                              self.capacity_factor)
-        out, aux = switch_moe(flat, p, capacity=cap)
+        # opportunistic expert parallelism: when the ACTIVE mesh (the
+        # one compile(mesh=...) handed the trainer) carries an 'expert'
+        # axis that divides both the expert count and the token count,
+        # experts shard over it and tokens travel by all_to_all;
+        # otherwise the single-device formulation runs (replicated
+        # experts — always correct)
+        from .....parallel.mesh import get_active_mesh
+        mesh = get_active_mesh()
+        esize = (mesh.shape["expert"]
+                 if mesh is not None and "expert" in mesh.axis_names
+                 else 0)
+        if esize > 1 and self.n_experts % esize == 0 \
+                and flat.shape[0] % esize == 0:
+            out, aux = moe_sharded(
+                flat, p, mesh, capacity_factor=self.capacity_factor)
+        else:
+            cap = expert_capacity(flat.shape[0], self.n_experts,
+                                  self.capacity_factor)
+            out, aux = switch_moe(flat, p, capacity=cap)
         y = out.reshape(inputs.shape)
         if self.residual:
             y = inputs + y
