@@ -91,13 +91,18 @@ def blockwise_attention(q, k, v, causal: bool = False,
 
 # ------------------------------------------------------------ pallas kernel
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sk: int,
-                  causal: bool, sq: int, scale: float, block_q: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                      sk: int, causal: bool, sq: int, scale: float,
+                      block_q: int):
     """One (batch·head, q-block) cell: iterate key blocks in VMEM with
     online softmax.  Matmuls run at the INPUT dtype (bf16 on the MXU's
     native rate) with f32 accumulation via ``preferred_element_type`` —
     casting inputs up to f32 first (the round-2 version) forfeited ~4× of
-    MXU throughput.  Softmax statistics stay f32 for stability."""
+    MXU throughput.  Softmax statistics stay f32 for stability.
+
+    Also writes the row logsumexp (``lse_ref``, (1, block_q) f32) — the
+    residual the custom-VJP backward kernels replay the softmax from
+    without re-running the online reduction."""
     q = q_ref[...]  # (block_q, d), input dtype
     qi = pl.program_id(1)
     n_kblocks = sk // block_k
@@ -137,7 +142,239 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sk: int,
     else:
         n_iter = n_kblocks
     m, l, o = lax.fori_loop(0, n_iter, body, (m0, l0, o0))
-    o_ref[...] = (o / jnp.maximum(l[:, None], 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[...] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :] = m + jnp.log(l_safe)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, sk: int, causal: bool,
+                         sq: int, scale: float, block_q: int):
+    """dq for one (batch·head, q-block) cell.  Replays the softmax from
+    the saved logsumexp (p = exp(s - lse), exact — no renormalization
+    pass), then dq += (p ∘ (do·vᵀ − Δ)) · k per key block, where
+    Δ = rowsum(do ∘ o) is precomputed outside the kernel."""
+    q = q_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[0, :]      # (block_q,) f32
+    delta = delta_ref[0, :]  # (block_q,) f32
+    qi = pl.program_id(1)
+    n_kblocks = sk // block_k
+    d = q.shape[-1]
+
+    def body(j, dq_acc):
+        k_blk = k_ref[pl.dslice(j * block_k, block_k), :]
+        v_blk = v_ref[pl.dslice(j * block_k, block_k), :]
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + (sk - sq)
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # masked scores underflow to 0
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq_acc + lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        last_q = (qi + 1) * block_q - 1 + (sk - sq)
+        n_iter = jnp.minimum(last_q // block_k + 1, n_kblocks)
+    else:
+        n_iter = n_kblocks
+    dq = lax.fori_loop(0, n_iter, body,
+                       jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, sq: int,
+                          causal: bool, sk: int, scale: float,
+                          block_k: int):
+    """dk/dv for one (batch·head, k-block) cell: iterate q blocks (full-
+    sequence q/do refs resident in VMEM), accumulating dv += pᵀ·do and
+    dk += dsᵀ·q.  Causality skips q blocks entirely before this key
+    block (start index), mirroring the forward's key-block skip."""
+    k_blk = k_ref[...]
+    v_blk = v_ref[...]
+    kj = pl.program_id(1)
+    n_qblocks = sq // block_q
+    d = k_blk.shape[-1]
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[pl.dslice(i * block_q, block_q), :]
+        do_blk = do_ref[pl.dslice(i * block_q, block_q), :]
+        lse_blk = lse_ref[0, pl.dslice(i * block_q, block_q)]
+        delta_blk = delta_ref[0, pl.dslice(i * block_q, block_q)]
+        s = lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + (sk - sq)
+            k_pos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])
+        dv_acc = dv_acc + lax.dot_general(
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk_acc = dk_acc + lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    if causal:
+        # first q block whose LAST row reaches this key block:
+        # i·block_q + block_q − 1 + (sk − sq) ≥ kj·block_k
+        start = jnp.maximum(0, (kj * block_k - (sk - sq)) // block_q)
+    else:
+        start = 0
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(start, n_qblocks, body, (z, z))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _mega(interpret: bool) -> dict:
+    """Megacore grid partitioning hints (harmless on one core)."""
+    if interpret:
+        return {}
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        return {"compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))}
+    except (ImportError, AttributeError):
+        return {}
+
+
+def _flash_fwd_call(qf, kf, vf, sq, sk, causal, block_q, block_k, scale,
+                    interpret):
+    bh, _, d = qf.shape
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k, sk=sk,
+                               causal=causal, sq=sq, scale=scale,
+                               block_q=block_q)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=interpret,
+        **_mega(interpret),
+    )(qf, kf, vf)
+
+
+# static config after the three differentiable operands
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_core(qf, kf, vf, sq, sk, causal, block_q, block_k, scale,
+                interpret):
+    """Flash attention on folded (batch·heads, seq, head_dim) arrays with
+    a flash BACKWARD (pallas dq and dk/dv kernels) — plain ``jax.grad``
+    of a ``pallas_call`` is unsupported (pallas has no general transpose
+    rule), and recomputing through the XLA blockwise path would forfeit
+    the kernel's advantage exactly where the training step spends ~2/3 of
+    its attention FLOPs."""
+    out, _ = _flash_fwd_call(qf, kf, vf, sq, sk, causal, block_q, block_k,
+                             scale, interpret)
+    return out
+
+
+def _flash_core_fwd(qf, kf, vf, sq, sk, causal, block_q, block_k, scale,
+                    interpret):
+    out, lse = _flash_fwd_call(qf, kf, vf, sq, sk, causal, block_q,
+                               block_k, scale, interpret)
+    return out, (qf, kf, vf, out, lse)
+
+
+def _flash_core_bwd(sq, sk, causal, block_q, block_k, scale, interpret,
+                    res, do):
+    qf, kf, vf, out, lse = res
+    bh, _, d = qf.shape
+    do = do.astype(qf.dtype)
+    # Δ_i = Σ_d do_id·o_id  (= Σ_j p_ij·dp_ij) — cheap elementwise, XLA
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    # backward blocks: q-chunk stays at the forward's (which divides sq
+    # by construction); key-chunk halves when possible — the dkv cell's
+    # (block_q × block_k) f32 p/dp/ds live simultaneously.  A prime-ish
+    # sk whose only small divisors are tiny keeps the forward's block
+    # rather than degenerating to a per-element grid.
+    bwd_bq = block_q
+    bwd_bk = _largest_divisor(sk, min(block_k, 512))
+    if bwd_bk < 8:
+        bwd_bk = block_k
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_k=bwd_bk, sk=sk, causal=causal, sq=sq,
+        scale=scale, block_q=bwd_bq)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, sq // bwd_bq),
+        in_specs=[
+            pl.BlockSpec((None, bwd_bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, bwd_bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bwd_bq), lambda i, j: (i, j)),
+            pl.BlockSpec((1, bwd_bq), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((None, bwd_bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), qf.dtype),
+        interpret=interpret,
+        **_mega(interpret),
+    )(qf, kf, vf, do, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=bwd_bq, sq=sq, causal=causal,
+        sk=sk, scale=scale, block_k=bwd_bk)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, sk // bwd_bk),
+        in_specs=[
+            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), vf.dtype),
+        ],
+        interpret=interpret,
+        **_mega(interpret),
+    )(qf, kf, vf, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
@@ -195,6 +432,17 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
         raise ValueError(
             f"seq lengths ({sq}, {sk}) have no usable block divisor — "
             "use blockwise/naive attention for prime-ish lengths")
+    if causal and sq > sk:
+        # rows aligned before the first key are FULLY masked; their
+        # backward replay (p = exp(s − lse)) would cancel the finite
+        # NEG_INF sentinel into phantom 1/n probabilities and corrupt
+        # dk/dv of valid rows — and the forward's "output" for such rows
+        # is meaningless anyway.  blockwise/naive keep the where-based
+        # autodiff semantics for this degenerate shape.
+        raise ValueError(
+            f"causal flash attention needs sq <= sk (got sq={sq}, "
+            f"sk={sk}): rows before the first key are fully masked — "
+            "use blockwise/naive attention")
     if layout == "bshd":
         # fold batch and heads into the grid's first axis — a materialized
         # transpose (see docstring; pass layout="bhsd" to avoid it)
@@ -207,30 +455,8 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
         kf = k.reshape(b * h, sk, d)
         vf = v.reshape(b * h, sk, d)
 
-    kernel = functools.partial(_flash_kernel, block_k=block_k, sk=sk,
-                               causal=causal, sq=sq, scale=scale,
-                               block_q=block_q)
-    kwargs = {}
-    if not interpret:
-        try:  # megacore partitions the parallel grid axis; harmless on 1
-            from jax.experimental.pallas import tpu as pltpu
-            kwargs["compiler_params"] = pltpu.CompilerParams(
-                dimension_semantics=("parallel", "arbitrary"))
-        except (ImportError, AttributeError):
-            pass
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        interpret=interpret,
-        **kwargs,
-    )(qf, kf, vf)
+    out = _flash_core(qf, kf, vf, sq, sk, causal, block_q, block_k,
+                      scale, interpret)
     if layout == "bshd":
         return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     return out.reshape(b, h, sq, d)
@@ -253,11 +479,12 @@ def attention_bhsd(q, k, v, causal: bool = False,
     other backends the arrays are transposed to the (b, s, h, d)
     contract around blockwise/naive (cheap on CPU, where this path is
     only a test oracle)."""
-    sq = q.shape[2]
-    bq, bk = _largest_divisor(sq, 256), _largest_divisor(k.shape[2], 1024)
+    sq, sk = q.shape[2], k.shape[2]
+    bq, bk = _largest_divisor(sq, 256), _largest_divisor(sk, 1024)
     on_tpu = jax.devices()[0].platform == "tpu"
     if implementation == "flash" or (
-            implementation == "auto" and on_tpu and min(bq, bk) >= 8):
+            implementation == "auto" and on_tpu and min(bq, bk) >= 8
+            and not (causal and sq > sk)):
         # explicit "flash" with no usable divisor RAISES inside
         # flash_attention (never a silent O(S²) naive fallback)
         return flash_attention(q, k, v, causal=causal, block_q=bq,
@@ -283,7 +510,8 @@ def attention(q, k, v, causal: bool = False, implementation: str = "auto"):
         if min(bq, bk) < 8:
             # prime-ish lengths: blocked kernels degenerate, use naive
             return naive_attention(q, k, v, causal=causal)
-        if jax.devices()[0].platform == "tpu":
+        if (jax.devices()[0].platform == "tpu"
+                and not (causal and sq > sk)):
             return flash_attention(q, k, v, causal=causal, block_q=bq,
                                    block_k=bk)
         return blockwise_attention(q, k, v, causal=causal, block_k=bk)

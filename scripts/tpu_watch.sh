@@ -1,0 +1,41 @@
+#!/bin/bash
+# Probe the TPU tunnel every PERIOD seconds; the moment it answers, run the
+# full bench plan and save the JSON line.  Exits 0 with a saved artifact on
+# success, exits 3 when DEADLINE seconds pass with no live chip.
+#
+# The probe is the same time-boxed child as bench.py::_probe_tpu — a hung
+# backend init must never block this loop inline.
+cd /root/repo || exit 2
+PERIOD=${PERIOD:-600}
+DEADLINE=${DEADLINE:-39600}   # 11h default
+OUT=${OUT:-/root/repo/BENCH_TPU_LIVE.json}
+START=$(date +%s)
+N=0
+while true; do
+  N=$((N + 1))
+  NOW=$(date +%s)
+  if [ $((NOW - START)) -gt "$DEADLINE" ]; then
+    echo "[tpu_watch] deadline reached after $N probes — chip never answered"
+    exit 3
+  fi
+  if timeout 300 python - <<'EOF'
+import jax, jax.numpy as jnp
+a = jnp.ones((256, 256), jnp.bfloat16)
+jax.jit(lambda a: a @ a)(a).block_until_ready()
+assert jax.devices()[0].platform != "cpu"
+print("TPU_PROBE_OK")
+EOF
+  then
+    echo "[tpu_watch] probe $N: ALIVE at $(date -u +%H:%M:%S) — running bench"
+    if timeout 4200 python bench.py > "$OUT" 2> /root/repo/tpu_watch_bench.log; then
+      echo "[tpu_watch] bench done -> $OUT"
+      cat "$OUT"
+      exit 0
+    else
+      echo "[tpu_watch] bench attempt failed (rc=$?) — see tpu_watch_bench.log; continuing to probe"
+    fi
+  else
+    echo "[tpu_watch] probe $N: dead ($(date -u +%H:%M:%S))"
+  fi
+  sleep "$PERIOD"
+done

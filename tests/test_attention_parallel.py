@@ -58,6 +58,109 @@ def test_flash_bhsd_layout_matches_bshd(causal):
         flash_attention(q, k, v, layout="sbhd", interpret=True)
 
 
+@pytest.mark.parametrize("causal,sq,sk,bq,bk", [
+    (True, 128, 128, 32, 32),
+    (False, 128, 128, 32, 32),
+    (True, 64, 128, 32, 32),    # rectangular: cached-kv decode shape
+    (True, 128, 128, 64, 32),   # uneven fwd blocks exercise bwd clamps
+])
+def test_flash_backward_matches_naive(causal, sq, sk, bq, bk):
+    """The custom-VJP backward (pallas dq + dk/dv kernels) must match the
+    naive oracle's autodiff — plain jax.grad of a pallas_call is
+    unsupported, so this path is what on-chip LM TRAINING runs through;
+    it was unreachable (AssertionError in pallas AD) until r5."""
+    q = qkv(b=2, s=sq, h=2, d=32, seed=1)[0]
+    _, k, v = qkv(b=2, s=sk, h=2, d=32, seed=2)
+    rng = np.random.default_rng(9)
+    ct = jnp.asarray(rng.normal(size=(2, sq, 2, 32)).astype(np.float32))
+    flash = lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=bq, block_k=bk, interpret=True)
+    ref = lambda q, k, v: naive_attention(q, k, v, causal=causal)
+    out_f, vjp_f = jax.vjp(flash, q, k, v)
+    out_n, vjp_n = jax.vjp(ref, q, k, v)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_n),
+                               rtol=2e-4, atol=2e-5)
+    for g_f, g_n in zip(vjp_f(ct), vjp_n(ct)):
+        np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_n),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_causal_rejects_fully_masked_rows():
+    """causal sq > sk: rows before the first key are fully masked; the
+    backward replay would cancel the NEG_INF sentinel into phantom 1/n
+    probabilities (code-review r5 finding) — flash raises, auto routes
+    to blockwise, and the oracle parity holds there."""
+    q = qkv(b=1, s=96, h=2, d=32, seed=5)[0]
+    _, k, v = qkv(b=1, s=48, h=2, d=32, seed=6)
+    with pytest.raises(ValueError, match="sq <= sk"):
+        flash_attention(q, k, v, causal=True, block_q=32, block_k=16,
+                        interpret=True)
+    out = attention(q, k, v, causal=True)  # auto: blockwise fallback
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(naive_attention(q, k, v, causal=True)),
+        rtol=2e-4, atol=2e-5)
+
+
+def test_flash_backward_prime_key_length_keeps_fwd_block():
+    """sk=1009 (prime): the backward must not degenerate to a
+    per-element grid — it falls back to the forward's block size."""
+    q = qkv(b=1, s=64, h=1, d=16, seed=7)[0]
+    _, k, v = qkv(b=1, s=1009, h=1, d=16, seed=8)
+    loss = lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=False, interpret=True) ** 2)
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(lambda q, k, v: jnp.sum(
+        naive_attention(q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_backward_bhsd_layout():
+    """Gradients flow through the transpose-free layout fold too."""
+    q, k, v = qkv(b=1, s=64, h=2, d=32, seed=4)
+    qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    loss_bhsd = lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True, block_q=32, block_k=32, interpret=True,
+        layout="bhsd") ** 2)
+    loss_naive = lambda q, k, v: jnp.sum(
+        naive_attention(q, k, v, causal=True) ** 2)
+    g_f = jax.grad(loss_bhsd, argnums=(0, 1, 2))(qt, kt, vt)
+    g_n = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_n):
+        np.testing.assert_allclose(np.asarray(a.transpose(0, 2, 1, 3)),
+                                   np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_mhsa_layer_trains_with_flash():
+    """The layer-level path on-chip training uses: MultiHeadSelfAttention
+    with implementation='flash' under jax.grad (interpret on CPU)."""
+    import optax
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Dense, Input as KInput, MultiHeadSelfAttention)
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+    from analytics_zoo_tpu.train.trainer import build_train_step
+
+    x_in = KInput((32, 16), name="flash_train_in")
+    h = MultiHeadSelfAttention(2, implementation="flash",
+                               name="flash_train_attn")(x_in)
+    graph = Model(input=x_in, output=Dense(4)(h)).to_graph()
+    params, state = graph.init(jax.random.PRNGKey(0))
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+    step = build_train_step(graph, objectives.get("mse"), opt)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 32, 16)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(4, 32, 4)).astype(np.float32))
+    losses = []
+    for _ in range(8):
+        params, state, opt_state, loss = step(
+            params, state, opt_state, jax.random.PRNGKey(1), x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]  # gradients are real and useful
+
+
 def test_attention_dispatch_and_validation():
     q, k, v = qkv(s=32)
     out = attention(q, k, v, implementation="blockwise")
