@@ -109,8 +109,9 @@ class KerasNet(Layer):
     # use).  The Trainer derives an optimizer mask from the flags —
     # frozen layers receive EXACTLY zero updates (stop_gradient alone
     # would leave stateful optimizers moving them on stale momentum) —
-    # and refreshes in place: weights and epoch/step counters survive,
-    # optimizer statistics reset. ----
+    # and refreshes in place: weights, epoch/step counters AND
+    # optimizer statistics all survive the toggle (the mask's state
+    # structure is invariant under freeze/unfreeze). ----
     def _layers_by_name(self):
         out = {}
         for v in self.to_graph().nodes:

@@ -173,21 +173,39 @@ class Trainer:
 
     def _mask_from_flags(self, base):
         """Wrap ``base`` so layers with ``trainable=False`` receive
-        EXACTLY zero updates (optax.set_to_zero routing — stop_gradient
-        alone leaves stateful optimizers moving frozen weights on stale
-        momentum)."""
-        frozen = self._frozen_names()
-        if not frozen:
-            return base
+        EXACTLY zero updates, with a state structure that is INVARIANT
+        under freeze/unfreeze: ``base``'s statistics always cover the
+        full parameter tree, and the frozen set lives only in the update
+        closure.  Toggling flags therefore never re-initializes
+        optimizer state — still-training layers keep their momentum /
+        Adam moments exactly, matching the reference's freeze
+        (scaleW/scaleB=0, which never touches OptimMethod state;
+        NetUtils.scala:216-277).
 
-        def labels(params):
-            return {k: jax.tree_util.tree_map(
-                        lambda _: ("frozen" if k in frozen
-                                   else "trainable"), sub)
-                    for k, sub in params.items()}
+        Both the gradients entering and the updates leaving ``base`` are
+        zeroed for frozen layers: zeroing the gradients keeps frozen
+        layers' moments from absorbing gradient signal while frozen
+        (they decay toward zero, equivalent to a fresh start on
+        unfreeze); zeroing the updates guarantees exactly-zero movement
+        even under stateful optimizers whose update is nonzero at zero
+        gradient (momentum, Adam bias correction)."""
+        frozen = frozenset(self._frozen_names())
 
-        return optax.multi_transform(
-            {"trainable": base, "frozen": optax.set_to_zero()}, labels)
+        def _zero_frozen(tree):
+            if not frozen:
+                return tree
+            return {k: (jax.tree_util.tree_map(jnp.zeros_like, sub)
+                        if k in frozen else sub)
+                    for k, sub in tree.items()}
+
+        def update(grads, state, params=None):
+            updates, new_state = base.update(_zero_frozen(grads), state,
+                                             params)
+            return _zero_frozen(updates), new_state
+
+        from ..pipeline.api.keras.optimizers import ZooOptimizer
+        return ZooOptimizer(base.init, update,
+                            lr_fn=getattr(base, "lr_fn", None))
 
     def invalidate_compiled(self):
         """Drop the compiled step functions (they re-trace lazily) —
@@ -200,13 +218,12 @@ class Trainer:
 
     def refresh_optimizer(self):
         """Re-derive the optimizer mask from the model's current
-        trainable flags and re-initialize optimizer STATISTICS from the
-        placed params (weights and epoch/step counters are preserved;
-        moments reset — stale momentum must not keep moving
-        freshly-frozen weights)."""
+        trainable flags.  Optimizer STATISTICS are untouched — the mask
+        wrapper's state structure is invariant under freeze/unfreeze
+        (``_mask_from_flags``), so still-training layers keep their
+        moments bit-for-bit and freshly-frozen weights cannot move on
+        stale momentum (their updates are hard-zeroed)."""
         self.optimizer = self._mask_from_flags(self._base_optimizer)
-        if self.state is not None:
-            self.state.opt_state = self.optimizer.init(self.state.params)
         self.invalidate_compiled()
 
     # ------------------------------------------------------------------

@@ -5,10 +5,11 @@
 #
 # The probe is the same time-boxed child as bench.py::_probe_tpu — a hung
 # backend init must never block this loop inline.
-cd /root/repo || exit 2
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO" || exit 2
 PERIOD=${PERIOD:-600}
 DEADLINE=${DEADLINE:-39600}   # 11h default
-OUT=${OUT:-/root/repo/BENCH_TPU_LIVE.json}
+OUT=${OUT:-$REPO/BENCH_TPU_LIVE.json}
 START=$(date +%s)
 N=0
 while true; do
@@ -27,7 +28,7 @@ print("TPU_PROBE_OK")
 EOF
   then
     echo "[tpu_watch] probe $N: ALIVE at $(date -u +%H:%M:%S) — running bench"
-    if timeout 4200 python bench.py > "$OUT" 2> /root/repo/tpu_watch_bench.log; then
+    if timeout 4200 python bench.py > "$OUT" 2> "$REPO/tpu_watch_bench.log"; then
       echo "[tpu_watch] bench done -> $OUT"
       cat "$OUT"
       exit 0

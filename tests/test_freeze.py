@@ -88,6 +88,39 @@ def test_freeze_exact_zero_updates_under_adam():
     assert not np.allclose(after["backbone2"]["W"], before["backbone2"]["W"])
 
 
+def test_freeze_toggle_preserves_adam_moments():
+    """Toggling freeze/unfreeze must NOT reset optimizer statistics:
+    the mask's state structure is invariant, so still-training layers
+    keep their Adam moments bit-for-bit (the reference's freeze is
+    scaleW/scaleB=0 and never touches OptimMethod state)."""
+    zoo.init_nncontext()
+    m = _model()
+    m.compile("adam", "mse")
+    x, y = _data()
+    m.fit(x, y, batch_size=32, nb_epoch=3)     # build up adam moments
+    before = jax.device_get(jax.tree_util.tree_leaves(
+        m.trainer.state.opt_state))
+    assert any(np.abs(l).max() > 0 for l in before
+               if np.asarray(l).ndim > 0), "moments never accumulated"
+    m.freeze("backbone2")
+    after = jax.device_get(jax.tree_util.tree_leaves(
+        m.trainer.state.opt_state))
+    assert len(before) == len(after)
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)
+    # and the same across the unfreeze direction, mid-training
+    m.fit(x, y, batch_size=32, nb_epoch=1)
+    before = jax.device_get(jax.tree_util.tree_leaves(
+        m.trainer.state.opt_state))
+    m.unfreeze()
+    after = jax.device_get(jax.tree_util.tree_leaves(
+        m.trainer.state.opt_state))
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)
+    # LearningRate telemetry survives the mask (lr_fn passthrough)
+    assert m.trainer.optimizer.lr_fn is not None
+
+
 def test_freeze_up_to_spares_parallel_branches():
     """Ancestor semantics: freezing up to one branch must not freeze a
     parallel branch (code-review r4)."""
