@@ -32,22 +32,52 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def naive_attention(q, k, v, causal: bool = False, scale: float = None):
-    """Materialized-scores attention (oracle)."""
+def _clamp_lengths(kv_lengths, sk):
+    """Normalize per-batch valid key lengths to f32 in [1, sk].
+
+    The floor of 1 keeps fully-masked rows out of every implementation
+    (softmax over an all-masked row is 0/0; the flash backward's
+    exp(s − lse) replay would cancel the NEG_INF sentinel into phantom
+    probabilities) — an "empty" sequence attends to position 0 and its
+    output must be masked downstream, which padded batches do anyway."""
+    lens = jnp.asarray(kv_lengths)
+    if lens.ndim != 1:
+        raise ValueError(
+            f"kv_lengths must be (batch,), got shape {lens.shape}")
+    return jnp.clip(lens.astype(jnp.float32), 1, sk)
+
+
+def naive_attention(q, k, v, causal: bool = False, scale: float = None,
+                    kv_lengths=None):
+    """Materialized-scores attention (oracle).
+
+    ``kv_lengths``: optional (batch,) valid key counts — keys at
+    positions >= kv_lengths[b] are masked out (right-padded variable-
+    length batches; the reference pads text to a fixed sequenceLength,
+    TextClassifier.scala:34).  Padded QUERY rows still produce (garbage)
+    outputs — mask them downstream, as sequence losses do."""
     b, sq, h, d = q.shape
+    sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
-        sk = k.shape[1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         scores = jnp.where(mask, scores, NEG_INF)
+    if kv_lengths is not None:
+        lens = _clamp_lengths(kv_lengths, sk)
+        kmask = (jnp.arange(sk)[None, :] < lens[:, None])  # (b, sk)
+        scores = jnp.where(kmask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
 def blockwise_attention(q, k, v, causal: bool = False,
-                        block_k: int = 512, scale: float = None):
-    """Online-softmax attention scanning key blocks: O(seq) memory."""
+                        block_k: int = 512, scale: float = None,
+                        kv_lengths=None):
+    """Online-softmax attention scanning key blocks: O(seq) memory.
+
+    ``kv_lengths``: optional (batch,) valid key counts (see
+    ``naive_attention``)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -55,6 +85,8 @@ def blockwise_attention(q, k, v, causal: bool = False,
     if sk % block_k != 0:
         raise ValueError(
             f"block_k ({block_k}) must divide the key length ({sk})")
+    lens = (None if kv_lengths is None
+            else _clamp_lengths(kv_lengths, sk))
     n_blocks = sk // block_k
     kb = k.reshape(b, n_blocks, block_k, h, d)
     vb = v.reshape(b, n_blocks, block_k, h, d)
@@ -65,10 +97,13 @@ def blockwise_attention(q, k, v, causal: bool = False,
         m_prev, l_prev, o_prev = carry
         k_blk, v_blk, blk_idx = blk
         scores = jnp.einsum("bqhd,bkhd->bhqk", q_scaled, k_blk)
+        k_pos = blk_idx * block_k + jnp.arange(block_k)
         if causal:
-            k_pos = blk_idx * block_k + jnp.arange(block_k)
             mask = q_pos[:, None] + (sk - sq) >= k_pos[None, :]
             scores = jnp.where(mask[None, None], scores, NEG_INF)
+        if lens is not None:
+            kmask = k_pos[None, :] < lens[:, None]  # (b, block_k)
+            scores = jnp.where(kmask[:, None, None, :], scores, NEG_INF)
         m_blk = jnp.max(scores, axis=-1)
         m_new = jnp.maximum(m_prev, m_blk)
         p = jnp.exp(scores - m_new[..., None])
@@ -91,9 +126,29 @@ def blockwise_attention(q, k, v, causal: bool = False,
 
 # ------------------------------------------------------------ pallas kernel
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+def _score_mask(scores, causal, lens_val, qi, j, block_q, block_k, sq, sk):
+    """Compose the causal and key-padding masks onto one score block.
+    ``lens_val`` is this (batch·head)'s valid key count (f32 scalar) or
+    None when the call has no padding mask."""
+    valid = None
+    if causal or lens_val is not None:
+        k_pos = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+    if causal:
+        q_pos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0) + (sk - sq)
+        valid = q_pos >= k_pos
+    if lens_val is not None:
+        kmask = k_pos.astype(jnp.float32) < lens_val
+        valid = kmask if valid is None else valid & kmask
+    if valid is None:
+        return scores
+    return jnp.where(valid, scores, NEG_INF)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int,
                       sk: int, causal: bool, sq: int, scale: float,
-                      block_q: int):
+                      block_q: int, masked: bool):
     """One (batch·head, q-block) cell: iterate key blocks in VMEM with
     online softmax.  Matmuls run at the INPUT dtype (bf16 on the MXU's
     native rate) with f32 accumulation via ``preferred_element_type`` —
@@ -102,7 +157,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     Also writes the row logsumexp (``lse_ref``, (1, block_q) f32) — the
     residual the custom-VJP backward kernels replay the softmax from
-    without re-running the online reduction."""
+    without re-running the online reduction.
+
+    ``masked=True`` adds a per-(batch·head) valid-key-count operand
+    (``lens_ref``, (1, 1) f32): keys at positions >= the count are
+    masked, and whole key blocks beyond it are skipped."""
+    if masked:
+        lens_ref, o_ref, lse_ref = rest
+        lens_val = lens_ref[0, 0]
+    else:
+        (o_ref, lse_ref), lens_val = rest, None
     q = q_ref[...]  # (block_q, d), input dtype
     qi = pl.program_id(1)
     n_kblocks = sk // block_k
@@ -114,12 +178,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         scores = lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + (sk - sq)
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        scores = _score_mask(scores, causal, lens_val, qi, j, block_q,
+                             block_k, sq, sk)
         m_blk = jnp.max(scores, axis=-1)
         m_new = jnp.maximum(m_prev, m_blk)
         p = jnp.exp(scores - m_new[:, None])
@@ -141,6 +201,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         n_iter = jnp.minimum(last_q // block_k + 1, n_kblocks)
     else:
         n_iter = n_kblocks
+    if masked:
+        # skip key blocks entirely past the valid length
+        n_valid = jnp.ceil(lens_val / block_k).astype(jnp.int32)
+        n_iter = jnp.minimum(n_iter, n_valid)
     m, l, o = lax.fori_loop(0, n_iter, body, (m0, l0, o0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[...] = (o / l_safe[:, None]).astype(o_ref.dtype)
@@ -148,12 +212,18 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, sk: int, causal: bool,
-                         sq: int, scale: float, block_q: int):
+                         *rest, block_k: int, sk: int, causal: bool,
+                         sq: int, scale: float, block_q: int,
+                         masked: bool):
     """dq for one (batch·head, q-block) cell.  Replays the softmax from
     the saved logsumexp (p = exp(s - lse), exact — no renormalization
     pass), then dq += (p ∘ (do·vᵀ − Δ)) · k per key block, where
     Δ = rowsum(do ∘ o) is precomputed outside the kernel."""
+    if masked:
+        lens_ref, dq_ref = rest
+        lens_val = lens_ref[0, 0]
+    else:
+        (dq_ref,), lens_val = rest, None
     q = q_ref[...]
     do = do_ref[...]
     lse = lse_ref[0, :]      # (block_q,) f32
@@ -168,12 +238,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + (sk - sq)
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _score_mask(s, causal, lens_val, qi, j, block_q, block_k,
+                        sq, sk)
         p = jnp.exp(s - lse[:, None])  # masked scores underflow to 0
         dp = lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -188,19 +254,29 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         n_iter = jnp.minimum(last_q // block_k + 1, n_kblocks)
     else:
         n_iter = n_kblocks
+    if masked:
+        n_valid = jnp.ceil(lens_val / block_k).astype(jnp.int32)
+        n_iter = jnp.minimum(n_iter, n_valid)
     dq = lax.fori_loop(0, n_iter, body,
                        jnp.zeros((block_q, d), jnp.float32))
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, sq: int,
+                          *rest, block_q: int, sq: int,
                           causal: bool, sk: int, scale: float,
-                          block_k: int):
+                          block_k: int, masked: bool):
     """dk/dv for one (batch·head, k-block) cell: iterate q blocks (full-
     sequence q/do refs resident in VMEM), accumulating dv += pᵀ·do and
     dk += dsᵀ·q.  Causality skips q blocks entirely before this key
-    block (start index), mirroring the forward's key-block skip."""
+    block (start index), mirroring the forward's key-block skip.
+    Padding-masked key blocks need no skip: their replayed p underflows
+    to exactly 0, so dk/dv of padded keys come out zero."""
+    if masked:
+        lens_ref, dk_ref, dv_ref = rest
+        lens_val = lens_ref[0, 0]
+    else:
+        (dk_ref, dv_ref), lens_val = rest, None
     k_blk = k_ref[...]
     v_blk = v_ref[...]
     kj = pl.program_id(1)
@@ -216,12 +292,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = i * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + (sk - sq)
-            k_pos = kj * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _score_mask(s, causal, lens_val, i, kj, block_q, block_k,
+                        sq, sk)
         p = jnp.exp(s - lse_blk[:, None])
         dv_acc = dv_acc + lax.dot_general(
             p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
@@ -241,8 +313,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         start = jnp.maximum(0, (kj * block_k - (sk - sq)) // block_q)
     else:
         start = 0
+    end = n_qblocks
+    if masked:
+        # a key block entirely past the valid length contributes zero
+        # dk/dv — write the zeros without iterating (fwd/dq skip's dual)
+        end = jnp.where(kj * block_k >= lens_val, start, end)
     z = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = lax.fori_loop(start, n_qblocks, body, (z, z))
+    dk, dv = lax.fori_loop(start, end, body, (z, z))
     dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
@@ -259,20 +336,25 @@ def _mega(interpret: bool) -> dict:
         return {}
 
 
-def _flash_fwd_call(qf, kf, vf, sq, sk, causal, block_q, block_k, scale,
-                    interpret):
+def _flash_fwd_call(qf, kf, vf, lens, sq, sk, causal, masked, block_q,
+                    block_k, scale, interpret):
     bh, _, d = qf.shape
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k, sk=sk,
                                causal=causal, sq=sq, scale=scale,
-                               block_q=block_q)
+                               block_q=block_q, masked=masked)
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+    ]
+    args = [qf, kf, vf]
+    if masked:
+        in_specs.append(pl.BlockSpec((1, 1), lambda i, j: (i, 0)))
+        args.append(lens)
     return pl.pallas_call(
         kernel,
         grid=(bh, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
@@ -283,34 +365,37 @@ def _flash_fwd_call(qf, kf, vf, sq, sk, causal, block_q, block_k, scale,
         ],
         interpret=interpret,
         **_mega(interpret),
-    )(qf, kf, vf)
+    )(*args)
 
 
-# static config after the three differentiable operands
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash_core(qf, kf, vf, sq, sk, causal, block_q, block_k, scale,
-                interpret):
+# static config after the four differentiable-position operands (``lens``
+# is a traced (bh, 1) f32 operand — lengths vary per batch at runtime —
+# whose cotangent is defined as zero)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _flash_core(qf, kf, vf, lens, sq, sk, causal, masked, block_q,
+                block_k, scale, interpret):
     """Flash attention on folded (batch·heads, seq, head_dim) arrays with
     a flash BACKWARD (pallas dq and dk/dv kernels) — plain ``jax.grad``
     of a ``pallas_call`` is unsupported (pallas has no general transpose
     rule), and recomputing through the XLA blockwise path would forfeit
     the kernel's advantage exactly where the training step spends ~2/3 of
     its attention FLOPs."""
-    out, _ = _flash_fwd_call(qf, kf, vf, sq, sk, causal, block_q, block_k,
-                             scale, interpret)
+    out, _ = _flash_fwd_call(qf, kf, vf, lens, sq, sk, causal, masked,
+                             block_q, block_k, scale, interpret)
     return out
 
 
-def _flash_core_fwd(qf, kf, vf, sq, sk, causal, block_q, block_k, scale,
-                    interpret):
-    out, lse = _flash_fwd_call(qf, kf, vf, sq, sk, causal, block_q,
-                               block_k, scale, interpret)
-    return out, (qf, kf, vf, out, lse)
+def _flash_core_fwd(qf, kf, vf, lens, sq, sk, causal, masked, block_q,
+                    block_k, scale, interpret):
+    out, lse = _flash_fwd_call(qf, kf, vf, lens, sq, sk, causal, masked,
+                               block_q, block_k, scale, interpret)
+    return out, (qf, kf, vf, lens, out, lse)
 
 
-def _flash_core_bwd(sq, sk, causal, block_q, block_k, scale, interpret,
-                    res, do):
-    qf, kf, vf, out, lse = res
+def _flash_core_bwd(sq, sk, causal, masked, block_q, block_k, scale,
+                    interpret, res, do):
+    qf, kf, vf, lens, out, lse = res
     bh, _, d = qf.shape
     do = do.astype(qf.dtype)
     # Δ_i = Σ_d do_id·o_id  (= Σ_j p_ij·dp_ij) — cheap elementwise, XLA
@@ -328,38 +413,48 @@ def _flash_core_bwd(sq, sk, causal, block_q, block_k, scale, interpret,
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_k=bwd_bk, sk=sk, causal=causal, sq=sq,
-        scale=scale, block_q=bwd_bq)
+        scale=scale, block_q=bwd_bq, masked=masked)
+    dq_specs = [
+        pl.BlockSpec((None, bwd_bq, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, bwd_bq, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, bwd_bq), lambda i, j: (i, j)),
+        pl.BlockSpec((1, bwd_bq), lambda i, j: (i, j)),
+    ]
+    dq_args = [qf, kf, vf, do, lse, delta]
+    if masked:
+        dq_specs.append(pl.BlockSpec((1, 1), lambda i, j: (i, 0)))
+        dq_args.append(lens)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, sq // bwd_bq),
-        in_specs=[
-            pl.BlockSpec((None, bwd_bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, bwd_bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bwd_bq), lambda i, j: (i, j)),
-            pl.BlockSpec((1, bwd_bq), lambda i, j: (i, j)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((None, bwd_bq, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), qf.dtype),
         interpret=interpret,
         **_mega(interpret),
-    )(qf, kf, vf, do, lse, delta)
+    )(*dq_args)
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=bwd_bq, sq=sq, causal=causal,
-        sk=sk, scale=scale, block_k=bwd_bk)
+        sk=sk, scale=scale, block_k=bwd_bk, masked=masked)
+    dkv_specs = [
+        pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+        pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+    ]
+    dkv_args = [qf, kf, vf, do, lse, delta]
+    if masked:
+        dkv_specs.append(pl.BlockSpec((1, 1), lambda i, j: (i, 0)))
+        dkv_args.append(lens)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, sk // bwd_bk),
-        in_specs=[
-            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
@@ -370,8 +465,8 @@ def _flash_core_bwd(sq, sk, causal, block_q, block_k, scale, interpret,
         ],
         interpret=interpret,
         **_mega(interpret),
-    )(qf, kf, vf, do, lse, delta)
-    return dq, dk, dv
+    )(*dkv_args)
+    return dq, dk, dv, jnp.zeros_like(lens)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -379,7 +474,8 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
                     block_k: int = 1024, scale: float = None,
-                    interpret: bool = False, layout: str = "bshd"):
+                    interpret: bool = False, layout: str = "bshd",
+                    kv_lengths=None):
     """Pallas TPU flash attention.
 
     Default blocks (q 256 × k 1024) are tuned on a v5e: measured (scan-
@@ -409,6 +505,11 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     ``interpret=True`` runs the kernel in the pallas interpreter (CPU
     testing — SURVEY §4's "local device = cluster" trick applied to
     kernels).
+
+    ``kv_lengths``: optional (batch,) valid key counts — keys at
+    positions >= kv_lengths[b] are masked INSIDE the kernels (forward
+    and both backward kernels), and whole key blocks beyond the length
+    are skipped.  See ``naive_attention`` for the padded-query caveat.
     """
     if layout == "bshd":
         b, sq, h, d = q.shape
@@ -455,8 +556,14 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
         kf = k.reshape(b * h, sk, d)
         vf = v.reshape(b * h, sk, d)
 
-    out = _flash_core(qf, kf, vf, sq, sk, causal, block_q, block_k,
-                      scale, interpret)
+    masked = kv_lengths is not None
+    if masked:
+        # per-(batch·head) lengths, matching the b-major fold order
+        lens = jnp.repeat(_clamp_lengths(kv_lengths, sk), h)[:, None]
+    else:
+        lens = jnp.zeros((b * h, 1), jnp.float32)  # inert placeholder
+    out = _flash_core(qf, kf, vf, lens, sq, sk, causal, masked, block_q,
+                      block_k, scale, interpret)
     if layout == "bshd":
         return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     return out.reshape(b, h, sq, d)
@@ -471,14 +578,17 @@ def _largest_divisor(n: int, cap: int) -> int:
 
 
 def attention_bhsd(q, k, v, causal: bool = False,
-                   implementation: str = "auto"):
+                   implementation: str = "auto", kv_lengths=None):
     """(b, h, s, d)-layout dispatch — the transpose-free fast path for
     transformer stacks that project qkv straight into bhsd
     (``einsum("bse,ehd->bhsd", ...)``; see flash_attention's layout
     note).  On TPU the pallas kernel consumes the layout directly; on
     other backends the arrays are transposed to the (b, s, h, d)
     contract around blockwise/naive (cheap on CPU, where this path is
-    only a test oracle)."""
+    only a test oracle).
+
+    ``kv_lengths``: optional (batch,) valid key counts — right-padded
+    batches mask keys past their length in every implementation."""
     sq, sk = q.shape[2], k.shape[2]
     bq, bk = _largest_divisor(sq, 256), _largest_divisor(sk, 1024)
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -489,19 +599,23 @@ def attention_bhsd(q, k, v, causal: bool = False,
         # flash_attention (never a silent O(S²) naive fallback)
         return flash_attention(q, k, v, causal=causal, block_q=bq,
                                block_k=bk, layout="bhsd",
-                               interpret=not on_tpu)
+                               interpret=not on_tpu,
+                               kv_lengths=kv_lengths)
     qs, ks, vs = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
     if implementation == "blockwise" or (
             implementation == "auto" and min(bq, bk) >= 8):
-        out = blockwise_attention(qs, ks, vs, causal=causal, block_k=bk)
+        out = blockwise_attention(qs, ks, vs, causal=causal, block_k=bk,
+                                  kv_lengths=kv_lengths)
     elif implementation in ("auto", "naive"):
-        out = naive_attention(qs, ks, vs, causal=causal)
+        out = naive_attention(qs, ks, vs, causal=causal,
+                              kv_lengths=kv_lengths)
     else:
         raise ValueError(f"Unknown implementation {implementation!r}")
     return out.transpose(0, 2, 1, 3)
 
 
-def attention(q, k, v, causal: bool = False, implementation: str = "auto"):
+def attention(q, k, v, causal: bool = False, implementation: str = "auto",
+              kv_lengths=None):
     """Dispatch: pallas on TPU, blockwise elsewhere; awkward sequence
     lengths (no usable block divisor) fall back to naive."""
     sq, sk = q.shape[1], k.shape[1]
@@ -509,16 +623,21 @@ def attention(q, k, v, causal: bool = False, implementation: str = "auto"):
         bq, bk = _largest_divisor(sq, 256), _largest_divisor(sk, 1024)
         if min(bq, bk) < 8:
             # prime-ish lengths: blocked kernels degenerate, use naive
-            return naive_attention(q, k, v, causal=causal)
+            return naive_attention(q, k, v, causal=causal,
+                                   kv_lengths=kv_lengths)
         if (jax.devices()[0].platform == "tpu"
                 and not (causal and sq > sk)):
             return flash_attention(q, k, v, causal=causal, block_q=bq,
-                                   block_k=bk)
-        return blockwise_attention(q, k, v, causal=causal, block_k=bk)
+                                   block_k=bk, kv_lengths=kv_lengths)
+        return blockwise_attention(q, k, v, causal=causal, block_k=bk,
+                                   kv_lengths=kv_lengths)
     if implementation == "flash":
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal,
+                               kv_lengths=kv_lengths)
     if implementation == "blockwise":
-        return blockwise_attention(q, k, v, causal=causal)
+        return blockwise_attention(q, k, v, causal=causal,
+                                   kv_lengths=kv_lengths)
     if implementation == "naive":
-        return naive_attention(q, k, v, causal=causal)
+        return naive_attention(q, k, v, causal=causal,
+                               kv_lengths=kv_lengths)
     raise ValueError(f"Unknown implementation {implementation!r}")

@@ -28,17 +28,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def _local_attention_accumulate(q, k_blk, v_blk, q_offset, k_offset,
-                                causal, scale, carry):
+                                causal, scale, carry, kv_lengths=None):
     """One ring step: accumulate online-softmax stats for local q against
-    one rotated k/v shard."""
+    one rotated k/v shard.  ``kv_lengths``: optional (batch,) GLOBAL
+    valid key counts — global key positions >= kv_lengths[b] are masked
+    (right-padded batches)."""
     m_prev, l_prev, o_prev = carry
     scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k_blk)
+    sq, sk = q.shape[1], k_blk.shape[1]
+    k_pos = k_offset + jnp.arange(sk)
     if causal:
-        sq, sk = q.shape[1], k_blk.shape[1]
         q_pos = q_offset + jnp.arange(sq)
-        k_pos = k_offset + jnp.arange(sk)
         mask = q_pos[:, None] >= k_pos[None, :]
         scores = jnp.where(mask[None, None], scores, -1e30)
+    if kv_lengths is not None:
+        kmask = k_pos[None, :] < kv_lengths[:, None]  # (b, sk)
+        scores = jnp.where(kmask[:, None, None, :], scores, -1e30)
     m_blk = jnp.max(scores, axis=-1)
     m_new = jnp.maximum(m_prev, m_blk)
     p = jnp.exp(scores - m_new[..., None])
@@ -50,11 +55,13 @@ def _local_attention_accumulate(q, k_blk, v_blk, q_offset, k_offset,
 
 
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, kv_lengths=None):
     """Call INSIDE shard_map with q/k/v sharded on their seq axis.
 
     Shapes (local): (batch, seq_local, heads, head_dim).
-    """
+    ``kv_lengths``: optional (batch,) GLOBAL valid key counts,
+    replicated across the ring (each sequence must have >= 1 valid
+    token; clamp before calling — the sharded wrapper does)."""
     b, sq, h, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     n = lax.axis_size(axis_name)
@@ -69,7 +76,7 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
         src = (my_idx - i) % n
         stats = _local_attention_accumulate(
             q, k_cur, v_cur, q_offset, src * k_cur.shape[1], causal,
-            scale, stats)
+            scale, stats, kv_lengths=kv_lengths)
         # rotate for the next step (last rotation is redundant but keeps
         # the loop uniform; XLA overlaps it with the epilogue)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
@@ -85,13 +92,24 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
 
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "seq",
-                           causal: bool = False):
+                           causal: bool = False, kv_lengths=None):
     """Convenience wrapper: shard (b, s, h, d) arrays on the seq axis and
-    run ring attention under shard_map."""
+    run ring attention under shard_map.  ``kv_lengths``: optional
+    (batch,) GLOBAL valid key counts (replicated over the ring)."""
     spec = P(None, axis_name, None, None)
+    if kv_lengths is None:
+        fn = shard_map(
+            functools.partial(ring_attention, axis_name=axis_name,
+                              causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v)
+    from ..ops.attention import _clamp_lengths
+    lens = _clamp_lengths(kv_lengths, k.shape[1])
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name,
-                          causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return fn(q, k, v)
+        lambda q_, k_, v_, l_: ring_attention(
+            q_, k_, v_, axis_name=axis_name, causal=causal,
+            kv_lengths=l_),
+        mesh=mesh, in_specs=(spec, spec, spec, P(None)),
+        out_specs=spec, check_vma=False)
+    return fn(q, k, v, lens)

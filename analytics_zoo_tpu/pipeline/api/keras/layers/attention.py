@@ -35,6 +35,15 @@ class MultiHeadSelfAttention(Layer):
       sequence, KV blocks rotate around the ring, so contexts beyond
       one chip's memory train like any other layer.  Requires the
       active mesh to carry a ``seq`` axis.
+
+    Padding masks (right-padded variable-length batches — the
+    reference's text domain pads to a fixed sequenceLength,
+    TextClassifier.scala:34): pass a TWO-input list ``[x, lengths]``
+    where ``lengths`` is (batch,) valid token counts.  Keys past each
+    sequence's length are masked in every implementation (including
+    inside the pallas flash kernels and across the ring); padded QUERY
+    positions still emit (garbage) outputs — mask them downstream, as
+    sequence losses and masked pooling do.  Composes with ``causal``.
     """
 
     def __init__(self, n_heads, head_dim=None, causal=True,
@@ -56,6 +65,9 @@ class MultiHeadSelfAttention(Layer):
         return hd
 
     def init_params(self, rng, input_shape):
+        if (isinstance(input_shape, (list, tuple)) and input_shape
+                and isinstance(input_shape[0], (list, tuple))):
+            input_shape = input_shape[0]  # [x, lengths] two-input form
         d_model = input_shape[-1]
         hd = self._dims(d_model)
         init = initializers.get(self.init_name)
@@ -70,6 +82,16 @@ class MultiHeadSelfAttention(Layer):
         }
 
     def call(self, params, state, inputs, training=False, rng=None):
+        lengths = None
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != 2:
+                raise ValueError(
+                    "MultiHeadSelfAttention takes either one input "
+                    "(batch, seq, d_model) or two ([x, lengths]); got "
+                    f"{len(inputs)} inputs")
+            inputs, lengths = inputs
+            if lengths.ndim == 2 and lengths.shape[-1] == 1:
+                lengths = lengths[:, 0]  # accept (batch, 1) columns
         if self.implementation == "ring":
             # sequence parallelism: project into the ring kernel's
             # (b, s, h, d) contract — still a pure einsum, no transpose
@@ -91,17 +113,22 @@ class MultiHeadSelfAttention(Layer):
             q = jnp.einsum("bse,ehd->bshd", inputs, params["Wq"])
             k = jnp.einsum("bse,ehd->bshd", inputs, params["Wk"])
             v = jnp.einsum("bse,ehd->bshd", inputs, params["Wv"])
-            o = ring_attention_sharded(q, k, v, mesh, causal=self.causal)
+            o = ring_attention_sharded(q, k, v, mesh, causal=self.causal,
+                                       kv_lengths=lengths)
             return jnp.einsum("bshd,hde->bse", o, params["Wo"])
         # project straight into (b, h, s, d) — layout rides the matmul
         q = jnp.einsum("bse,ehd->bhsd", inputs, params["Wq"])
         k = jnp.einsum("bse,ehd->bhsd", inputs, params["Wk"])
         v = jnp.einsum("bse,ehd->bhsd", inputs, params["Wv"])
         o = attention_bhsd(q, k, v, causal=self.causal,
-                           implementation=self.implementation)
+                           implementation=self.implementation,
+                           kv_lengths=lengths)
         return jnp.einsum("bhsd,hde->bse", o, params["Wo"])
 
     def compute_output_shape(self, input_shape):
+        if (isinstance(input_shape, (list, tuple)) and input_shape
+                and isinstance(input_shape[0], (list, tuple))):
+            return tuple(input_shape[0])  # [x, lengths] two-input form
         return tuple(input_shape)
 
     def get_config(self):
