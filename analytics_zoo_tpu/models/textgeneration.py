@@ -54,20 +54,24 @@ class TransformerLM(ZooModel):
     def __init__(self, vocab_size=None, seq_len=128, n_layers=2,
                  d_model=128, n_heads=4, d_ff=None, max_len=None,
                  dropout=0.0, implementation="auto", moe_every=None,
-                 n_experts=8, name=None, **kw):
+                 n_experts=8, capacity_factor=1.25, name=None, **kw):
         super().__init__(
             name=name, vocab_size=vocab_size, seq_len=seq_len,
             n_layers=n_layers, d_model=d_model, n_heads=n_heads,
             d_ff=d_ff or 4 * d_model, max_len=max_len or seq_len,
             dropout=dropout, implementation=implementation,
-            moe_every=moe_every, n_experts=n_experts, **kw)
+            moe_every=moe_every, n_experts=n_experts,
+            capacity_factor=capacity_factor, **kw)
 
     def build_model(self) -> Model:
         h = self.hyper
         tokens = Input(shape=(h["seq_len"],), name="tokens")
+        # explicit names: the KV-cache decode path (generation.py) reads
+        # these params by layer name
         x = Embedding(h["vocab_size"], h["d_model"],
-                      input_length=h["seq_len"])(tokens)
-        x = PositionalEmbedding(h["max_len"])(x)
+                      input_length=h["seq_len"],
+                      name="tok_embed")(tokens)
+        x = PositionalEmbedding(h["max_len"], name="pos_embed")(x)
         for i in range(h["n_layers"]):
             a = LayerNorm(name=f"ln_attn_{i}")(x)
             a = MultiHeadSelfAttention(
@@ -86,6 +90,8 @@ class TransformerLM(ZooModel):
                 # MoE FFN); aux loss auto-wired through layer state
                 f = SwitchMoE(n_experts=h["n_experts"],
                               hidden_dim=h["d_ff"], residual=False,
+                              capacity_factor=h.get("capacity_factor",
+                                                    1.25),
                               name=f"moe_{i}")(f)
             else:
                 f = Dense(h["d_ff"], activation="gelu",
@@ -98,3 +104,13 @@ class TransformerLM(ZooModel):
         logits = Dense(h["vocab_size"], name="lm_head")(x)
         out = Activation("log_softmax")(logits)
         return Model(input=tokens, output=out, name="transformer_lm")
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, top_k=None, seed: int = 0):
+        """Autoregressive continuation from a KV cache — greedy
+        (``temperature=0``) or temperature/top-k sampling; the whole
+        decode runs as ONE compiled scan.  See
+        :func:`analytics_zoo_tpu.models.generation.generate`."""
+        from .generation import generate
+        return generate(self, prompt_ids, max_new_tokens,
+                        temperature=temperature, top_k=top_k, seed=seed)

@@ -290,6 +290,16 @@ def child(platform: str):
     else:
         extras["int8_inference"] = {"skipped": "extras deadline"}
 
+    # ---- TransformerLM KV-cache decode tokens/sec (generate()) ----
+    if _extras_budget_left("lm_decode", 200 if on_tpu else 60):
+        try:
+            extras["lm_decode"] = _bench_lm_decode(jax, jnp, np, on_tpu)
+        except Exception as e:
+            extras["lm_decode"] = {"error": f"{type(e).__name__}: {e}"}
+            _log(f"lm decode bench failed: {e}")
+    else:
+        extras["lm_decode"] = {"skipped": "extras deadline"}
+
     # ---- TransformerLM training tokens/sec (long-context flagship;
     # exercises the transpose-free bhsd flash-attention path in a full
     # model rather than a microbench) ----
@@ -609,6 +619,48 @@ def _bench_transformer_lm(jax, jnp, np, on_tpu: bool):
             "attention": ("pallas flash, bhsd projection" if on_tpu
                           else "blockwise XLA (cpu fallback)"),
             "method": f"lax.scan x{n_steps} inside one jit"}
+
+
+def _bench_lm_decode(jax, jnp, np, on_tpu: bool):
+    """KV-cache autoregressive decode throughput (generated tokens/s):
+    TransformerLM.generate — prefill one batched causal pass, then ONE
+    compiled lax.scan over decode steps (no per-token dispatch, so the
+    tunnel's multi-ms floor is paid once per call, not per token)."""
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.models.generation import build_generate_fn
+
+    if on_tpu:
+        vocab, batch = 32000, 8
+        n_layers, d_model, n_heads = 12, 768, 12
+        s_p, max_new, max_len = 512, 128, 1024
+    else:
+        vocab, batch = 256, 2
+        n_layers, d_model, n_heads = 2, 64, 2
+        s_p, max_new, max_len = 32, 16, 64
+    lm = TransformerLM(vocab_size=vocab, seq_len=max_len,
+                       n_layers=n_layers, d_model=d_model,
+                       n_heads=n_heads)
+    trainer = lm.ensure_inference_ready()
+    fn = build_generate_fn(lm.hyper, s_p, max_new, 0.0, None)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, vocab, (batch, s_p)), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    toks = fn(trainer.state.params, prompt, key)
+    toks.block_until_ready()
+    best = 1e9
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.time()
+        fn(trainer.state.params, prompt, key).block_until_ready()
+        best = min(best, time.time() - t0)
+    tps = batch * max_new / best
+    _log(f"lm decode: {best * 1e3:.0f} ms for {max_new} new tokens x "
+         f"batch {batch} -> {tps:,.0f} tokens/s")
+    return {"decode_tokens_per_sec": round(tps, 1),
+            "ms_total": round(best * 1e3, 1),
+            "config": {"n_layers": n_layers, "d_model": d_model,
+                       "n_heads": n_heads, "prompt_len": s_p,
+                       "max_new": max_new, "batch": batch},
+            "method": "prefill + single-jit scan decode, greedy"}
 
 
 def _bench_attention(jax, jnp, on_tpu: bool):

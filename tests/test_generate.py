@@ -1,0 +1,102 @@
+"""TransformerLM.generate — KV-cache decode correctness (VERDICT r4 #3).
+
+The gold standard is the TRAINING forward (the graph model's full
+causal pass, already oracle-tested): the cached decode path must
+reproduce its per-position log-probabilities exactly, and greedy
+generation must equal repeated full-forward argmax."""
+
+import numpy as np
+import pytest
+import jax
+
+import analytics_zoo_tpu as zoo
+from analytics_zoo_tpu.models import TransformerLM
+
+
+VOCAB, SEQ = 59, 32
+
+
+def _trained_lm(**kw):
+    zoo.init_nncontext()
+    m = TransformerLM(vocab_size=VOCAB, seq_len=SEQ, n_layers=2,
+                      d_model=32, n_heads=2, **kw)
+    m.compile({"name": "adam", "lr": 5e-3}, "class_nll")
+    rng = np.random.default_rng(0)
+    # learnable structure: next token = (token + 1) % VOCAB
+    x = rng.integers(0, VOCAB, (128, SEQ))
+    y = (x + 1) % VOCAB
+    m.fit(x, y, batch_size=32, nb_epoch=8)
+    return m
+
+
+def _full_forward_argmax(m, ids):
+    """argmax of the graph model's log-probs at the LAST position of a
+    padded-to-seq_len window (teacher forcing oracle)."""
+    pad = np.zeros((ids.shape[0], SEQ - ids.shape[1]), ids.dtype)
+    window = np.concatenate([ids, pad], axis=1)
+    logp = m.predict(window, batch_size=ids.shape[0])
+    return np.argmax(logp[:, ids.shape[1] - 1], axis=-1)
+
+
+def test_greedy_matches_repeated_full_forward():
+    """Each greedily generated token must equal the full (uncached)
+    forward's argmax at that position — pins prefill AND every cached
+    step to the training path."""
+    m = _trained_lm()
+    prompt = np.random.default_rng(1).integers(0, VOCAB, (3, 8))
+    out = m.generate(prompt, max_new_tokens=6, temperature=0.0)
+    assert out.shape == (3, 14)
+    np.testing.assert_array_equal(out[:, :8], prompt)
+    for t in range(6):
+        expect = _full_forward_argmax(m, out[:, :8 + t])
+        np.testing.assert_array_equal(
+            out[:, 8 + t], expect,
+            err_msg=f"cached decode diverged at step {t}")
+
+
+def test_generate_trained_structure():
+    """The trained (x+1)%V structure must come out of the decoder."""
+    m = _trained_lm()
+    prompt = np.arange(10, 18)[None, :]
+    out = m.generate(prompt, max_new_tokens=5, temperature=0.0)
+    np.testing.assert_array_equal(out[0, 8:], (np.arange(18, 23)) % VOCAB)
+
+
+def test_sampling_modes():
+    m = _trained_lm()
+    prompt = np.random.default_rng(2).integers(0, VOCAB, (2, 8))
+    g1 = m.generate(prompt, max_new_tokens=4, temperature=1.0, seed=0)
+    g2 = m.generate(prompt, max_new_tokens=4, temperature=1.0, seed=1)
+    assert g1.shape == g2.shape == (2, 12)
+    # astronomically unlikely to collide on every token if sampling works
+    assert not np.array_equal(g1, g2)
+    # same seed -> deterministic
+    g3 = m.generate(prompt, max_new_tokens=4, temperature=1.0, seed=0)
+    np.testing.assert_array_equal(g1, g3)
+    # top-k=1 at any temperature collapses to greedy
+    gk = m.generate(prompt, max_new_tokens=4, temperature=0.7, top_k=1,
+                    seed=5)
+    gg = m.generate(prompt, max_new_tokens=4, temperature=0.0)
+    np.testing.assert_array_equal(gk, gg)
+
+
+def test_generate_moe_variant():
+    """The Switch-MoE sublayer decodes through the same cache path.
+    capacity_factor = n_experts makes BOTH paths drop-free (decode is
+    always drop-free; the full-forward oracle needs the headroom) so
+    they agree exactly."""
+    m = _trained_lm(moe_every=2, n_experts=4, capacity_factor=4.0)
+    prompt = np.random.default_rng(3).integers(0, VOCAB, (2, 8))
+    out = m.generate(prompt, max_new_tokens=4, temperature=0.0)
+    for t in range(4):
+        expect = _full_forward_argmax(m, out[:, :8 + t])
+        np.testing.assert_array_equal(out[:, 8 + t], expect,
+                                      err_msg=f"moe decode step {t}")
+
+
+def test_generate_validation():
+    m = _trained_lm()
+    with pytest.raises(ValueError, match="max_len"):
+        m.generate(np.zeros((1, 30), np.int32), max_new_tokens=10)
+    with pytest.raises(ValueError, match="prompt_ids"):
+        m.generate(np.zeros((8,), np.int32), max_new_tokens=2)
