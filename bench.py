@@ -175,10 +175,31 @@ def child(platform: str):
     _log(f"step-only: {best * 1e3:.2f} ms/step -> {images_per_sec:.1f} "
          "img/s")
 
-    extras = {"platform": dev.platform,
-              "device_kind": getattr(dev, "device_kind", "unknown"),
-              "batch": batch, "image_size": size,
-              "analysis": "PERF_NOTES.md"}
+    class _Sink(dict):
+        """Progressive partial-results file: every section write lands
+        on disk immediately, so an attempt killed mid-run (the tunnel
+        can die between sections and block the next one forever) still
+        leaves its completed sections as evidence."""
+        path = os.path.join(REPO, f"BENCH_PARTIAL_{platform}.json")
+
+        def __setitem__(self, k, v):
+            super().__setitem__(k, v)
+            try:
+                with open(self.path, "w") as f:
+                    json.dump({**self, "partial": True,
+                               "wall_elapsed_s":
+                                   round(time.time() - child_start, 1)},
+                              f, indent=1)
+            except OSError:
+                pass
+
+    extras = _Sink()
+    extras["platform"] = dev.platform
+    extras["device_kind"] = getattr(dev, "device_kind", "unknown")
+    extras["batch"] = batch
+    extras["image_size"] = size
+    extras["analysis"] = "PERF_NOTES.md"
+    extras["step_only_images_per_sec"] = round(images_per_sec, 2)
 
     # ---- input-fed mode: ImageLoader decodes real JPEGs feeding the
     # same compiled step through the streaming dataset + prefetch ----
@@ -314,6 +335,10 @@ def child(platform: str):
         extras["transformer_lm"] = {"skipped": "extras deadline"}
 
     baseline = 100.0  # nominal target (no published reference number)
+    try:  # reached the final print: the partial file is superseded
+        os.remove(extras.path)
+    except OSError:
+        pass
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(images_per_sec, 2),
