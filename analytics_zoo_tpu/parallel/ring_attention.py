@@ -55,18 +55,34 @@ def _local_attention_accumulate(q, k_blk, v_blk, q_offset, k_offset,
 
 
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
-                   scale: Optional[float] = None, kv_lengths=None):
+                   scale: Optional[float] = None, kv_lengths=None,
+                   block_k: int = 1024):
     """Call INSIDE shard_map with q/k/v sharded on their seq axis.
 
     Shapes (local): (batch, seq_local, heads, head_dim).
     ``kv_lengths``: optional (batch,) GLOBAL valid key counts,
     replicated across the ring (each sequence must have >= 1 valid
-    token; clamp before calling — the sharded wrapper does)."""
+    token; clamp before calling — the sharded wrapper does).
+
+    ``block_k`` sub-blocks each held K/V shard inside a ring step, so
+    per-device peak memory is O(seq_local · block_k) score tiles rather
+    than O(seq_local · shard) — without it the score matrix per step is
+    (seq/n)², which quietly reintroduces quadratic per-device memory as
+    sequences grow at fixed ring size (measured: ring_report r5)."""
     b, sq, h, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     q_offset = my_idx * sq
+    shard = k.shape[1]
+    from ..ops.attention import _largest_divisor
+    block_k = _largest_divisor(shard, min(block_k, shard))
+    if block_k < 8:
+        # prime-ish shard: a tiny divisor would degrade each ring step
+        # to a per-element scan — keep the whole-shard matmul instead
+        # (same guard as the flash path's bwd_bk floor)
+        block_k = shard
+    n_sub = shard // block_k
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -74,9 +90,18 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
         k_cur, v_cur, stats = carry
         # the shard currently held started at ((my_idx - i) mod n)·L
         src = (my_idx - i) % n
-        stats = _local_attention_accumulate(
-            q, k_cur, v_cur, q_offset, src * k_cur.shape[1], causal,
-            scale, stats, kv_lengths=kv_lengths)
+        base = src * shard
+
+        def sub(j, st):
+            k_blk = lax.dynamic_slice_in_dim(k_cur, j * block_k,
+                                             block_k, axis=1)
+            v_blk = lax.dynamic_slice_in_dim(v_cur, j * block_k,
+                                             block_k, axis=1)
+            return _local_attention_accumulate(
+                q, k_blk, v_blk, q_offset, base + j * block_k, causal,
+                scale, st, kv_lengths=kv_lengths)
+
+        stats = lax.fori_loop(0, n_sub, sub, stats)
         # rotate for the next step (last rotation is redundant but keeps
         # the loop uniform; XLA overlaps it with the epilogue)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)

@@ -1,0 +1,140 @@
+"""Ring-attention scaling evidence (VERDICT r4 #7): sequence length vs
+per-device memory vs throughput, ring vs single-device.
+
+Ring attention's reason to exist is sequences that do NOT fit one
+device: activations stay sharded seq/n per device and K/V shards rotate
+over the ring, so per-device peak memory is O(seq/n) while a
+single-device pass holds the full O(seq) activations (and naive
+attention O(seq²) scores).  This module makes that claim MEASURED, not
+asserted: for each sequence length it compiles both formulations and
+reads XLA's own per-device memory analysis (temp + argument bytes),
+then executes them for wall-time — on the virtual 8-device CPU mesh
+(SURVEY §4's local-cluster trick) or a real slice alike.
+
+Usage::
+
+    python -m analytics_zoo_tpu.parallel.ring_report
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .ring_attention import ring_attention_sharded
+
+
+def _mem(compiled) -> Optional[int]:
+    """Per-device temp+argument bytes from XLA's memory analysis."""
+    try:
+        m = compiled.memory_analysis()
+        if m is None:
+            return None
+        return int(getattr(m, "temp_size_in_bytes", 0)
+                   + getattr(m, "argument_size_in_bytes", 0))
+    except Exception:
+        return None
+
+
+def _time_call(fn, *args, iters=3) -> float:
+    """Warm once, then average ``iters`` timed calls (ms).  Works on a
+    jitted function or an AOT-compiled executable alike — pass the
+    compiled object to avoid a second trace+compile through the jit
+    cache."""
+    jax.block_until_ready(fn(*args))  # warm (compiles if not AOT)
+    t0 = time.time()
+    for _ in range(iters):
+        jax.block_until_ready(fn(*args))
+    return (time.time() - t0) / iters * 1e3
+
+
+def compare_ring(mesh=None, seq_lengths: Sequence[int] = (2048, 8192,
+                                                          32768),
+                 batch: int = 1, heads: int = 2, head_dim: int = 64,
+                 causal: bool = True, run_single_up_to: int = 8192,
+                 run_ring_up_to: int = 8192, iters: int = 1) -> Dict:
+    """Ring (sharded over the mesh's ``seq`` axis) vs single-device
+    blockwise attention across ``seq_lengths``.
+
+    ``run_single_up_to`` / ``run_ring_up_to`` bound which lengths each
+    formulation is EXECUTED at; beyond them only the compiled per-device
+    memory analysis is reported.  The memory column is the evidence that
+    matters (ring exists exactly so the single-device run stops being
+    necessary); CPU-mesh wall times are structural, not absolute — on a
+    real slice raise both caps.
+    Returns {seq: {ring: {...}, single: {...}}} with per-device bytes
+    and wall ms.
+    """
+    from . import mesh as mesh_lib
+    from ..ops.attention import blockwise_attention
+
+    mesh = mesh or mesh_lib.get_default_mesh()
+    if "seq" not in mesh.axis_names:
+        raise ValueError("mesh must carry a 'seq' axis "
+                         "(create_mesh({'seq': n}))")
+    n = mesh.shape["seq"]
+    rows: Dict[str, Dict] = {}
+    rng = np.random.default_rng(0)
+    for seq in seq_lengths:
+        if seq % n:
+            raise ValueError(f"seq {seq} not divisible by ring size {n}")
+        mk = lambda: jnp.asarray(
+            rng.normal(size=(batch, seq, heads, head_dim)), jnp.float32)
+        q, k, v = mk(), mk(), mk()
+
+        ring_fn = jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, mesh, causal=causal))
+        single_fn = jax.jit(lambda q, k, v: blockwise_attention(
+            q, k, v, causal=causal, block_k=min(1024, seq)))
+
+        entry: Dict = {"ring": {}, "single_device": {}}
+        # time the AOT executable directly — calling the jitted fn
+        # would re-trace and compile a second time
+        ring_c = ring_fn.lower(q, k, v).compile()
+        entry["ring"]["per_device_bytes"] = _mem(ring_c)
+        if seq <= run_ring_up_to:
+            entry["ring"]["wall_ms"] = round(
+                _time_call(ring_c, q, k, v, iters=iters), 1)
+        else:
+            entry["ring"]["wall_ms"] = None
+        single_c = single_fn.lower(q, k, v).compile()
+        entry["single_device"]["per_device_bytes"] = _mem(single_c)
+        if seq <= run_single_up_to:
+            entry["single_device"]["wall_ms"] = round(
+                _time_call(single_c, q, k, v, iters=iters), 1)
+        else:
+            entry["single_device"]["wall_ms"] = None
+            entry["single_device"]["note"] = (
+                "not executed — beyond the single-device budget "
+                "(memory analysis only)")
+        rb, sb = (entry["ring"]["per_device_bytes"],
+                  entry["single_device"]["per_device_bytes"])
+        if rb and sb:
+            entry["memory_ratio_single_over_ring"] = round(sb / rb, 2)
+        rows[str(seq)] = entry
+    return {"mesh": dict(mesh.shape), "batch": batch, "heads": heads,
+            "head_dim": head_dim, "causal": causal,
+            "ring_devices": n, "rows": rows}
+
+
+def main():
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    from . import mesh as mesh_lib
+    mesh = mesh_lib.create_mesh({"seq": 8})
+    print(json.dumps(compare_ring(mesh), indent=2))
+
+
+if __name__ == "__main__":
+    main()

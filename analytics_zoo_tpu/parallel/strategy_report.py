@@ -117,10 +117,16 @@ def compare_strategies(mesh=None,
         # same path here as they would under a Trainer compiled with
         # this mesh — otherwise the report's collective counts could
         # disagree with real training
+        from ..pipeline.api.keras.layers import moe as moe_layer
+        moe_layer.clear_fallback_log()
         with mesh_lib.active_mesh(mesh):
             compiled = jitted.lower(params, state, opt_state, key, x,
                                     y).compile()
         entry: Dict = {}
+        if moe_layer.EXPERT_FALLBACKS:
+            # a SwitchMoE ran replicated despite an expert axis — the
+            # report must say so next to the numbers it affects
+            entry["moe_fallbacks"] = dict(moe_layer.EXPERT_FALLBACKS)
         try:
             entry["collectives"] = _collective_counts(compiled.as_text())
         except Exception:
