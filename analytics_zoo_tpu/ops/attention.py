@@ -349,19 +349,26 @@ def _flash_fwd_call(qf, kf, vf, lens, sq, sk, causal, masked, block_q,
     ]
     args = [qf, kf, vf]
     if masked:
-        in_specs.append(pl.BlockSpec((1, 1), lambda i, j: (i, 0)))
+        in_specs.append(pl.BlockSpec((None, 1, 1), lambda i, j: (i, 0, 0)))
         args.append(lens)
+    # per-row statistics (lse; lens/delta in the backward) carry an
+    # explicit singleton dim — (bh, 1, sq) blocked (None, 1, block_q) —
+    # because TPU lowering requires each of a block's minor two dims to
+    # be tile-divisible (8/128) OR equal to the full array dim.  A 2-D
+    # (bh, sq) stat blocked (1, block_q) puts a size-1 sublane against
+    # bh and cannot lower (caught on the first live-chip run of the
+    # custom-VJP path, r5).
     return pl.pallas_call(
         kernel,
         grid=(bh, sq // block_q),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), qf.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
         interpret=interpret,
         **_mega(interpret),
@@ -400,7 +407,7 @@ def _flash_core_bwd(sq, sk, causal, masked, block_q, block_k, scale,
     do = do.astype(qf.dtype)
     # Δ_i = Σ_d do_id·o_id  (= Σ_j p_ij·dp_ij) — cheap elementwise, XLA
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)
+                    axis=-1)[:, None, :]  # (bh, 1, sq), like lse
     # backward blocks: q-chunk stays at the forward's (which divides sq
     # by construction); key-chunk halves when possible — the dkv cell's
     # (block_q × block_k) f32 p/dp/ds live simultaneously.  A prime-ish
@@ -419,12 +426,12 @@ def _flash_core_bwd(sq, sk, causal, masked, block_q, block_k, scale,
         pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
         pl.BlockSpec((None, bwd_bq, d), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, bwd_bq), lambda i, j: (i, j)),
-        pl.BlockSpec((1, bwd_bq), lambda i, j: (i, j)),
+        pl.BlockSpec((None, 1, bwd_bq), lambda i, j: (i, 0, j)),
+        pl.BlockSpec((None, 1, bwd_bq), lambda i, j: (i, 0, j)),
     ]
     dq_args = [qf, kf, vf, do, lse, delta]
     if masked:
-        dq_specs.append(pl.BlockSpec((1, 1), lambda i, j: (i, 0)))
+        dq_specs.append(pl.BlockSpec((None, 1, 1), lambda i, j: (i, 0, 0)))
         dq_args.append(lens)
     dq = pl.pallas_call(
         dq_kernel,
@@ -444,12 +451,12 @@ def _flash_core_bwd(sq, sk, causal, masked, block_q, block_k, scale,
         pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((None, bwd_bk, d), lambda i, j: (i, j, 0)),
         pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
-        pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+        pl.BlockSpec((None, 1, sq), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((None, 1, sq), lambda i, j: (i, 0, 0)),
     ]
     dkv_args = [qf, kf, vf, do, lse, delta]
     if masked:
-        dkv_specs.append(pl.BlockSpec((1, 1), lambda i, j: (i, 0)))
+        dkv_specs.append(pl.BlockSpec((None, 1, 1), lambda i, j: (i, 0, 0)))
         dkv_args.append(lens)
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -559,9 +566,9 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     masked = kv_lengths is not None
     if masked:
         # per-(batch·head) lengths, matching the b-major fold order
-        lens = jnp.repeat(_clamp_lengths(kv_lengths, sk), h)[:, None]
+        lens = jnp.repeat(_clamp_lengths(kv_lengths, sk), h)[:, None, None]
     else:
-        lens = jnp.zeros((b * h, 1), jnp.float32)  # inert placeholder
+        lens = jnp.zeros((b * h, 1, 1), jnp.float32)  # inert placeholder
     out = _flash_core(qf, kf, vf, lens, sq, sk, causal, masked, block_q,
                       block_k, scale, interpret)
     if layout == "bshd":
