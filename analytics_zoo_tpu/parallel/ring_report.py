@@ -31,14 +31,9 @@ from .ring_attention import ring_attention_sharded
 
 def _mem(compiled) -> Optional[int]:
     """Per-device temp+argument bytes from XLA's memory analysis."""
-    try:
-        m = compiled.memory_analysis()
-        if m is None:
-            return None
-        return int(getattr(m, "temp_size_in_bytes", 0)
-                   + getattr(m, "argument_size_in_bytes", 0))
-    except Exception:
-        return None
+    from .report_util import memory_analysis_bytes
+    m = memory_analysis_bytes(compiled)
+    return None if m is None else m["temp"] + m["argument"]
 
 
 def _time_call(fn, *args, iters=3) -> float:
@@ -123,14 +118,8 @@ def compare_ring(mesh=None, seq_lengths: Sequence[int] = (2048, 8192,
 
 
 def main():
-    import os
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
-    jax.config.update("jax_platforms",
-                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    from .report_util import force_cpu_mesh_env
+    force_cpu_mesh_env()
     from . import mesh as mesh_lib
     mesh = mesh_lib.create_mesh({"seq": 8})
     print(json.dumps(compare_ring(mesh), indent=2))

@@ -28,6 +28,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .report_util import force_cpu_mesh_env, memory_analysis_bytes
+
 COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
                "collective-permute", "all-to-all")
 
@@ -139,15 +141,10 @@ def compare_strategies(mesh=None,
                 entry["bytes_accessed"] = float(c.get("bytes accessed", 0))
         except Exception:
             pass
-        try:
-            mem = compiled.memory_analysis()
-            if mem is not None:
-                entry["temp_bytes"] = int(
-                    getattr(mem, "temp_size_in_bytes", 0))
-                entry["argument_bytes"] = int(
-                    getattr(mem, "argument_size_in_bytes", 0))
-        except Exception:
-            pass
+        mem = memory_analysis_bytes(compiled)
+        if mem is not None:
+            entry["temp_bytes"] = mem["temp"]
+            entry["argument_bytes"] = mem["argument"]
         # warm-up + timed steps through the AOT executable (calling
         # jitted(...) would re-trace and compile a second time)
         params, state, opt_state, loss = compiled(params, state,
@@ -172,14 +169,7 @@ def compare_strategies(mesh=None,
 
 
 def main():
-    import os
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8").strip()
-    jax.config.update("jax_platforms",
-                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    force_cpu_mesh_env()
     from . import mesh as mesh_lib
     mesh = mesh_lib.create_mesh({"data": 2, "fsdp": 2, "tensor": 2})
     mesh_lib.set_default_mesh(mesh)
