@@ -211,11 +211,12 @@ def generate(model, prompt_ids, max_new_tokens: int,
         raise ValueError(
             f"prompt ({s_p}) + max_new_tokens ({max_new_tokens}) = "
             f"{total} exceeds max_len ({h['max_len']})")
-    if h["implementation"] == "ring":
-        raise ValueError(
-            "generate() decodes single-chip from the KV cache; rebuild "
-            "the model with implementation='auto' for inference (the "
-            "weights transfer via get_weights/set_weights)")
+    # the decode path is implementation-agnostic: it reads params by
+    # layer name and computes its own cached attention, so a model
+    # TRAINED with ring (sequence-parallel) attention decodes here
+    # unchanged — the KV cache for one sequence fits one device, which
+    # is why there is no ring decode.  (Params under any strategy are
+    # replicated or resharded by the jit on first call.)
     trainer = model.ensure_inference_ready()
     key = (s_p, int(max_new_tokens), float(temperature),
            None if top_k is None else int(top_k))

@@ -94,6 +94,35 @@ def test_generate_moe_variant():
                                       err_msg=f"moe decode step {t}")
 
 
+def test_generate_from_ring_trained_model():
+    """A model TRAINED with sequence-parallel ring attention decodes
+    through the same single-chip KV-cache path (the decode reads params
+    by name and computes its own attention, so the training
+    implementation must not matter): greedy output equals an
+    implementation='auto' model carrying the same weights."""
+    import jax as _jax
+    from analytics_zoo_tpu.parallel.mesh import create_mesh
+    zoo.init_nncontext()
+    n = len(_jax.devices())
+    mesh = create_mesh({"data": 1, "seq": n})
+    ring = TransformerLM(vocab_size=VOCAB, seq_len=SEQ, n_layers=2,
+                         d_model=32, n_heads=2, implementation="ring")
+    ring.compile({"name": "adam", "lr": 5e-3}, "class_nll", mesh=mesh)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, VOCAB, (64, SEQ))
+    ring.fit(x, (x + 1) % VOCAB, batch_size=16, nb_epoch=2)
+
+    prompt = np.random.default_rng(5).integers(0, VOCAB, (2, 8))
+    out_ring = ring.generate(prompt, max_new_tokens=5, temperature=0.0)
+
+    auto = TransformerLM(vocab_size=VOCAB, seq_len=SEQ, n_layers=2,
+                         d_model=32, n_heads=2)
+    auto.compile({"name": "adam", "lr": 5e-3}, "class_nll")
+    auto.transfer_weights_from(ring)
+    out_auto = auto.generate(prompt, max_new_tokens=5, temperature=0.0)
+    np.testing.assert_array_equal(out_ring, out_auto)
+
+
 def test_generate_validation():
     m = _trained_lm()
     with pytest.raises(ValueError, match="max_len"):
