@@ -153,7 +153,8 @@ def load_tf_keras_weights(net, keras_model) -> object:
                 beta = np.zeros((n,), np.float32)
             mean, var = w[i], w[i + 1]
             params[ol.name] = {"gamma": gamma, "beta": beta}
-            state[ol.name] = {"moving_mean": mean, "moving_var": var}
+            state[ol.name] = {"moving_mean": mean, "moving_var": var,
+                              "count": np.float32(np.inf)}
     return _apply(net, params, state)
 
 
@@ -242,5 +243,8 @@ def load_torch_state_dict(net, state_dict) -> object:
                 "gamma": g.get("weight", np.ones((n,), np.float32)),
                 "beta": g.get("bias", np.zeros((n,), np.float32))}
             state[ol.name] = {"moving_mean": g["running_mean"],
-                              "moving_var": g["running_var"]}
+                              "moving_var": g["running_var"],
+                              # imported running stats are converged
+                              # averages: inf => debias denom 1
+                              "count": np.float32(np.inf)}
     return _apply(net, params, state)

@@ -41,8 +41,14 @@ class BatchNormalization(Layer):
 
     def init_state(self, input_shape):
         n = self._num_features(input_shape)
+        # ``count`` = number of EMA updates applied, used to DEBIAS the
+        # moving statistics at inference (below).  Imported pretrained
+        # stats are already-converged averages: loaders set count=inf so
+        # the debias denominator is exactly 1 and they pass through
+        # untouched (models/weight_loading.py).
         return {"moving_mean": jnp.zeros((n,)),
-                "moving_var": jnp.ones((n,))}
+                "moving_var": jnp.ones((n,)),
+                "count": jnp.zeros((), jnp.float32)}
 
     def apply(self, params, state, inputs, training=False, rng=None):
         from .....ops.batchnorm import (batch_norm_train,
@@ -67,11 +73,33 @@ class BatchNormalization(Layer):
                 "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
                 "moving_var": m * state["moving_var"] + (1 - m) * var,
             }
+            if "count" in state:
+                new_state["count"] = state["count"] + 1.0
         else:
+            mean = state["moving_mean"]
+            var = state["moving_var"]
+            cnt = state.get("count")
+            if cnt is not None:
+                # Debias against the (0, 1) init, Adam-style: after t
+                # updates the EMA still carries weight m^t on its init
+                # value — with the Keras-1 default m=0.99 that is 37 %
+                # after 100 steps, which through a deep BN stack makes
+                # short-trained models evaluate near chance even though
+                # training converged.  ema_t = m^t·init + (1−m^t)·avg,
+                # so the unbiased batch-stat average is
+                # (ema_t − m^t·init) / (1 − m^t); count=0 falls back to
+                # the init and count=inf (imported stats) is exact
+                # pass-through.
+                m = self.momentum
+                decay = jnp.power(m, cnt)
+                denom = jnp.maximum(1.0 - decay, 1e-12)
+                mean = jnp.where(cnt > 0, mean / denom,
+                                 jnp.zeros_like(mean))
+                var = jnp.where(cnt > 0, (var - decay) / denom,
+                                jnp.ones_like(var))
             out = batch_norm_inference(
                 inputs, params["gamma"], params["beta"],
-                state["moving_mean"], state["moving_var"],
-                self.epsilon, ch_axis)
+                mean, var, self.epsilon, ch_axis)
             new_state = state
         return out, new_state
 

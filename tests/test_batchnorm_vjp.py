@@ -140,3 +140,44 @@ def test_layer_uses_restructured_core_and_updates_state():
                                        training=False)
     assert same_state is new_state
     assert np.isfinite(np.asarray(out_eval)).all()
+
+
+def test_inference_stats_are_debiased():
+    """The inference path debiases the EMA against its (0, 1) init
+    (Adam-style): after only ONE training step on a batch with mean mu
+    and var s2, eval must normalize with (~mu, ~s2) — not with the
+    init-dominated blend 0.99*init + 0.01*stat.  This is what makes a
+    short-trained deep BN stack evaluate sanely (a 27-BN-layer model
+    trained ~100 steps previously evaluated at chance)."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        BatchNormalization)
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(3.0, 2.0, (512, 4)).astype(np.float32))
+    layer = BatchNormalization(input_shape=(4,))
+    params = {"gamma": jnp.ones((4,)), "beta": jnp.zeros((4,))}
+    state = layer.init_state((512, 4))
+    _, st1 = layer.apply(params, state, x, training=True)
+    assert float(st1["count"]) == 1.0
+    out, _ = layer.apply(params, st1, x, training=False)
+    # debiased eval ~= train-mode standardization of the same batch
+    np.testing.assert_allclose(np.asarray(out).mean(axis=0), 0.0,
+                               atol=1e-2)
+    np.testing.assert_allclose(np.asarray(out).std(axis=0), 1.0,
+                               atol=2e-2)
+
+    # count=inf (imported converged stats): exact pass-through
+    st_imp = {"moving_mean": jnp.asarray([1.0, 2.0, 3.0, 4.0]),
+              "moving_var": jnp.asarray([1.0, 4.0, 9.0, 16.0]),
+              "count": jnp.asarray(np.inf, jnp.float32)}
+    out_imp, _ = layer.apply(params, st_imp, x, training=False)
+    ref = (np.asarray(x) - np.array([1, 2, 3, 4.0])) / np.sqrt(
+        np.array([1, 4, 9, 16.0]) + layer.epsilon)
+    np.testing.assert_allclose(np.asarray(out_imp), ref, rtol=1e-4,
+                               atol=1e-4)
+
+    # count=0 (never trained): falls back to the (0, 1) init exactly
+    out0, _ = layer.apply(params, layer.init_state((512, 4)), x,
+                          training=False)
+    ref0 = np.asarray(x) / np.sqrt(1.0 + layer.epsilon)
+    np.testing.assert_allclose(np.asarray(out0), ref0, rtol=1e-4,
+                               atol=1e-4)

@@ -356,9 +356,12 @@ def test_batchnorm_inference_vs_keras():
     shape = (6, 6, 3)
     zoo = L.BatchNormalization(epsilon=1e-3)
     params, state = zoo.init(jax.random.PRNGKey(0), (B,) + shape)
-    # non-trivial moving statistics
+    # non-trivial moving statistics, set externally (the pretrained-
+    # import case): count=inf marks them as converged averages so the
+    # debias pass-through is exact and the keras comparison is 1:1
     state = {"moving_mean": jnp.asarray(_rand((3,))),
-             "moving_var": jnp.asarray(np.abs(_rand((3,))) + 0.5)}
+             "moving_var": jnp.asarray(np.abs(_rand((3,))) + 0.5),
+             "count": jnp.asarray(np.inf, jnp.float32)}
     params = {"gamma": jnp.asarray(_rand((3,))),
               "beta": jnp.asarray(_rand((3,)))}
     x = _rand((B,) + shape)
