@@ -37,16 +37,21 @@ import numpy as np
 import jax
 
 
+def _path_name(path) -> str:
+    """THE canonical leaf-path -> name derivation.  Save-side manifests
+    and restore-side templates must agree exactly (the by-name
+    structure-evolution restore matches on these strings), so every
+    site derives names through this one function."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path) or "leaf"
+
+
 def _flatten_with_names(tree):
     """Leaves are returned AS-IS (no host transfer) — sharded leaves of a
     pod-wide array must not be gathered here."""
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    names, leaves = [], []
-    for path, leaf in flat:
-        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                        for k in path)
-        names.append(name or "leaf")
-        leaves.append(leaf)
+    names = [_path_name(path) for path, _ in flat]
+    leaves = [leaf for _, leaf in flat]
     return names, leaves, treedef
 
 
@@ -131,10 +136,39 @@ def restore_checkpoint(directory: str, template, tag: Any = None):
     path = os.path.join(directory, f"ckpt_{tag}.npz")
     data = np.load(path)
     leaves = [data[f"arr_{i}"] for i in range(len(data.files))]
-    flat, treedef = jax.tree_util.tree_flatten(template)
+    flat_np, treedef = jax.tree_util.tree_flatten_with_path(template)
+    flat = [leaf for _, leaf in flat_np]
     if len(flat) != len(leaves):
-        raise ValueError(
-            f"Checkpoint has {len(leaves)} leaves, template has {len(flat)}")
+        # structure evolution (same bridge as restore_sharded): the
+        # flat manifest records leaf names — match by name and fill
+        # registered post-save leaves (e.g. BatchNormalization's debias
+        # ``count``) from RESTORE_DEFAULTS
+        saved_names = None
+        manifest_path = os.path.join(directory, f"ckpt_{tag}.json")
+        if os.path.exists(manifest_path):
+            with open(manifest_path) as f:
+                saved_names = json.load(f).get("names")
+        if saved_names is None or len(saved_names) != len(leaves):
+            raise ValueError(
+                f"Checkpoint has {len(leaves)} leaves, template has "
+                f"{len(flat)} (and no usable name manifest to bridge)")
+        by_name = {n: i for i, n in enumerate(saved_names)}
+        remapped = []
+        for (p, tmpl) in flat_np:
+            name = _path_name(p)
+            si = by_name.get(name)
+            if si is not None:
+                remapped.append(leaves[si])
+                continue
+            d = _fill_default(name, tmpl)
+            if d is None:
+                raise ValueError(
+                    f"checkpoint {tag} has no leaf named {name!r} and "
+                    "no restore default is registered for it — model/"
+                    "optimizer structure changed since the save in a "
+                    "way restore cannot bridge")
+            remapped.append(d)
+        leaves = remapped
     for tmpl, loaded in zip(flat, leaves):
         if np.shape(tmpl) != loaded.shape:
             raise ValueError(
@@ -159,6 +193,47 @@ def _flatten_none_aware(tree):
     restore must agree on leaf indices even for trees containing None
     (e.g. optax.masked / inject_hyperparams states)."""
     return jax.tree_util.tree_flatten(tree, is_leaf=_none_leaf)
+
+
+def _leaf_names(tree):
+    """Path-string per leaf (None-aware flatten, matching the sharded
+    format's save-side manifest)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree, is_leaf=_none_leaf)[0]
+    return [_path_name(path) for path, _ in flat]
+
+
+# Structure-evolution escape hatch: a layer that ADDS a state leaf in a
+# later version registers a restore default here, so checkpoints saved
+# before the addition still load (restore matches leaves BY NAME against
+# the manifest and fills registered absentees).  The layer owns the
+# migration semantics — e.g. BatchNormalization fills its debias
+# ``count`` with inf, which makes pre-existing moving stats behave
+# exactly as they did when saved.
+RESTORE_DEFAULTS: list = []
+
+
+def register_restore_default(pattern: str, fill) -> None:
+    """``pattern`` is a regex matched (re.search) against the leaf's
+    path name; ``fill(template_leaf) -> array`` produces the value."""
+    RESTORE_DEFAULTS.append((re.compile(pattern), fill))
+
+
+def _fill_default(name, tmpl):
+    for pat, fill in RESTORE_DEFAULTS:
+        if pat.search(name):
+            return np.asarray(fill(tmpl))
+    return None
+
+
+# BatchNormalization's debias ``count`` leaf (added r5; the layer keeps
+# user-assignable names so the match is on the leaf name alone —
+# registered here rather than in the layer module to avoid a
+# layers -> train import cycle).  Pre-existing moving stats restore as
+# converged averages (count=inf => debias denominator 1): exactly the
+# inference semantics they had when saved.
+register_restore_default(
+    r"(^|/)count$",
+    lambda tmpl: np.full(np.shape(tmpl), np.inf, np.float32))
 
 
 def _encode_index(index, shape):
@@ -196,8 +271,7 @@ def _snapshot_shards(tree):
     """Synchronously copy this process's shards to host memory (so the
     training loop may donate/overwrite the device buffers immediately)."""
     flat = jax.tree_util.tree_flatten_with_path(tree, is_leaf=_none_leaf)[0]
-    names = ["/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                      for k in path) or "leaf" for path, _ in flat]
+    names = [_path_name(path) for path, _ in flat]
     leaves = [leaf for _, leaf in flat]
     arrays = {}
     shapes, dtypes = [], []
@@ -300,11 +374,12 @@ def restore_sharded(directory: str, template, tag: Any = None,
     # the manifest records how many processes wrote this save; reading
     # exactly that set ignores stale shard files from an older save of
     # the same tag under a larger pod
-    n_saved = None
+    manifest = {}
     manifest_path = os.path.join(directory, f"ckpt_{tag}.json")
     if os.path.exists(manifest_path):
         with open(manifest_path) as f:
-            n_saved = json.load(f).get("n_processes")
+            manifest = json.load(f)
+    n_saved = manifest.get("n_processes")
     if n_saved is not None:
         shard_files = [f"ckpt_{tag}.shard-p{p}.npz" for p in range(n_saved)]
         missing = [f for f in shard_files
@@ -331,29 +406,69 @@ def restore_sharded(directory: str, template, tag: Any = None,
         raise ValueError(
             f"shardings tree has {len(shard_flat)} leaves, template has "
             f"{len(flat)} — structures must match")
+    # leaf-index remap for structure evolution: when the manifest's
+    # saved names differ from the template's (a layer added/moved a
+    # state leaf since the save), match BY NAME; template leaves absent
+    # from the save fill from RESTORE_DEFAULTS or fail loudly
+    saved_names = manifest.get("names")
+    tmpl_names = _leaf_names(template)
+    defaults: dict = {}
+    # equal leaf counts => positional (the normal resume path; auto-
+    # numbered layer names routinely drift between two builds of the
+    # same model, so name equality is NOT required).  A count mismatch
+    # means the structure genuinely changed since the save — then match
+    # by name, which requires the save and the template to use stable
+    # layer names for the leaves they share.
+    if saved_names is not None and len(saved_names) != len(tmpl_names):
+        by_name = {n: i for i, n in enumerate(saved_names)}
+        remap = []
+        for ti, name in enumerate(tmpl_names):
+            si = by_name.get(name)
+            if si is None and flat[ti] is not None:
+                d = _fill_default(name, flat[ti])
+                if d is None:
+                    raise ValueError(
+                        f"checkpoint {tag} has no leaf named {name!r} "
+                        "and no restore default is registered for it — "
+                        "model/optimizer structure changed since the "
+                        "save in a way restore cannot bridge")
+                defaults[ti] = d
+            remap.append(si)
+    else:
+        remap = list(range(len(flat)))
     # index every entry key by leaf (npz members load lazily, so this
     # only reads the zip directories), then assemble + place ONE leaf at
     # a time — restore stays bounded by the largest leaf, not the whole
     # state (the same bounded-memory property save has)
     handles = [np.load(os.path.join(directory, f)) for f in shard_files]
     try:
+        n_saved_leaves = (len(saved_names) if saved_names is not None
+                          else len(flat))
         by_leaf: dict = {}
         for h in handles:
             for key in h.files:
                 si, _, idx_text = key.partition("|")
                 i = int(si)
-                if i >= len(flat):
+                if i >= n_saved_leaves:
                     raise ValueError(
-                        f"checkpoint {tag} has a leaf index {i} but the "
-                        f"template has only {len(flat)} leaves — model/"
-                        "optimizer structure changed since the save?")
+                        f"checkpoint {tag} has a leaf index {i} but "
+                        f"records only {n_saved_leaves} leaves — shard "
+                        "files from a different save mixed in?")
                 by_leaf.setdefault(i, []).append((h, key, idx_text))
         placed = []
         for i, (tmpl, sh) in enumerate(zip(flat, shard_flat)):
             if tmpl is None:
                 placed.append(None)
                 continue
-            entries = by_leaf.get(i)
+            if i in defaults:  # registered fill for a post-save leaf
+                buf = defaults[i]
+                if sh is None:
+                    placed.append(buf)
+                else:
+                    placed.append(jax.make_array_from_callback(
+                        np.shape(buf), sh, lambda idx, b=buf: b[idx]))
+                continue
+            entries = by_leaf.get(remap[i])
             if not entries:
                 raise ValueError(
                     f"checkpoint {tag} is missing data for leaf {i} "

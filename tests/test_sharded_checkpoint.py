@@ -209,3 +209,62 @@ def test_keras_fit_auto_resume(tmp_path):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="set_checkpoint"):
         m3.fit(x, y, batch_size=16, nb_epoch=1, resume=True)
+
+
+def test_restore_fills_post_save_state_leaf_by_name(tmp_path):
+    """Structure evolution (r5): a checkpoint saved BEFORE a layer grew
+    a new state leaf (BatchNormalization's debias ``count``) must still
+    restore — leaves match by manifest name, and the absent ``count``
+    fills from its registered default (inf = converged pass-through).
+    An absent leaf with NO registered default still fails loudly."""
+    from analytics_zoo_tpu.train.checkpoint import (restore_sharded,
+                                                    save_sharded)
+    old = {"params": {"dense": {"W": np.arange(6, dtype=np.float32)
+                                .reshape(2, 3)}},
+           "model_state": {"bn_7": {
+               "moving_mean": np.array([1.0, 2.0], np.float32),
+               "moving_var": np.array([3.0, 4.0], np.float32)}}}
+    save_sharded(str(tmp_path), 1, old)
+
+    template = {"params": {"dense": {"W": np.zeros((2, 3), np.float32)}},
+                "model_state": {"bn_7": {
+                    "moving_mean": np.zeros(2, np.float32),
+                    "moving_var": np.ones(2, np.float32),
+                    "count": np.zeros((), np.float32)}}}
+    out = restore_sharded(str(tmp_path), template, 1)
+    np.testing.assert_array_equal(out["params"]["dense"]["W"],
+                                  old["params"]["dense"]["W"])
+    np.testing.assert_array_equal(
+        out["model_state"]["bn_7"]["moving_mean"], [1.0, 2.0])
+    assert np.isinf(out["model_state"]["bn_7"]["count"])
+
+    bad_template = dict(template)
+    bad_template["params"] = {"dense": {
+        "W": np.zeros((2, 3), np.float32),
+        "brand_new_bias": np.zeros(3, np.float32)}}
+    with pytest.raises(ValueError, match="no restore default"):
+        restore_sharded(str(tmp_path), bad_template, 1)
+
+
+def test_flat_restore_fills_post_save_state_leaf_by_name(tmp_path):
+    """The FLAT format (save_checkpoint/restore_checkpoint — the
+    NNModel.save path) gets the same structure-evolution bridge via its
+    name manifest."""
+    from analytics_zoo_tpu.train.checkpoint import (restore_checkpoint,
+                                                    save_checkpoint)
+    old = {"model_state": {"bn": {
+        "moving_mean": np.array([1.0, 2.0], np.float32),
+        "moving_var": np.array([3.0, 4.0], np.float32)}},
+        "params": {"d": {"W": np.ones((2, 2), np.float32)}}}
+    save_checkpoint(str(tmp_path), 2, old)
+    template = {"model_state": {"bn": {
+        "moving_mean": np.zeros(2, np.float32),
+        "moving_var": np.ones(2, np.float32),
+        "count": np.zeros((), np.float32)}},
+        "params": {"d": {"W": np.zeros((2, 2), np.float32)}}}
+    out = restore_checkpoint(str(tmp_path), template, 2)
+    np.testing.assert_array_equal(out["model_state"]["bn"]["moving_var"],
+                                  [3.0, 4.0])
+    assert np.isinf(out["model_state"]["bn"]["count"])
+    np.testing.assert_array_equal(out["params"]["d"]["W"],
+                                  np.ones((2, 2)))
