@@ -152,23 +152,10 @@ def restore_checkpoint(directory: str, template, tag: Any = None):
             raise ValueError(
                 f"Checkpoint has {len(leaves)} leaves, template has "
                 f"{len(flat)} (and no usable name manifest to bridge)")
-        by_name = {n: i for i, n in enumerate(saved_names)}
-        remapped = []
-        for (p, tmpl) in flat_np:
-            name = _path_name(p)
-            si = by_name.get(name)
-            if si is not None:
-                remapped.append(leaves[si])
-                continue
-            d = _fill_default(name, tmpl)
-            if d is None:
-                raise ValueError(
-                    f"checkpoint {tag} has no leaf named {name!r} and "
-                    "no restore default is registered for it — model/"
-                    "optimizer structure changed since the save in a "
-                    "way restore cannot bridge")
-            remapped.append(d)
-        leaves = remapped
+        tmpl_named = [(_path_name(p), tmpl) for p, tmpl in flat_np]
+        leaves = [leaves[si] if si is not None else d
+                  for si, d in _remap_by_name(tag, saved_names,
+                                              tmpl_named)]
     for tmpl, loaded in zip(flat, leaves):
         if np.shape(tmpl) != loaded.shape:
             raise ValueError(
@@ -223,6 +210,58 @@ def _fill_default(name, tmpl):
         if pat.search(name):
             return np.asarray(fill(tmpl))
     return None
+
+
+def _remap_by_name(tag, saved_names, tmpl_named):
+    """The structure-evolution bridge shared by both restore formats.
+
+    ``tmpl_named`` is [(name, template_leaf)].  Returns a parallel list
+    of (saved_index, default): exactly one of the pair is non-None —
+    the saved leaf to load, or the registered-default fill for a leaf
+    added after the save.  Raises for an unbridgeable absence."""
+    by_name = {n: i for i, n in enumerate(saved_names)}
+    out = []
+    for name, tmpl in tmpl_named:
+        si = by_name.get(name)
+        if si is not None:
+            out.append((si, None))
+            continue
+        if tmpl is None:  # structural None carries no data
+            out.append((None, None))
+            continue
+        d = _fill_default(name, tmpl)
+        if d is None:
+            raise ValueError(
+                f"checkpoint {tag} has no leaf named {name!r} and no "
+                "restore default is registered for it — model/optimizer "
+                "structure changed since the save in a way restore "
+                "cannot bridge")
+        out.append((None, d))
+    return out
+
+
+def _strip_auto_numbers(name: str) -> str:
+    """Drop the trailing ``_<n>`` auto-number from each path component —
+    two builds of the same model differ only in these."""
+    return "/".join(re.sub(r"_\d+$", "", part)
+                    for part in name.split("/"))
+
+
+def _warn_positional_name_drift(tag, saved_names, tmpl_names):
+    """Equal leaf counts restore positionally; when the names disagree
+    beyond auto-number drift the load may still be wrong (a same-shape
+    leaf swapped for a semantically different one) — surface it."""
+    mismatched = [(s, t) for s, t in zip(saved_names, tmpl_names)
+                  if _strip_auto_numbers(s) != _strip_auto_numbers(t)]
+    if mismatched:
+        import warnings
+        s, t = mismatched[0]
+        warnings.warn(
+            f"checkpoint {tag}: {len(mismatched)} leaf name(s) disagree "
+            f"with the template beyond layer auto-numbering (first: "
+            f"saved {s!r} vs template {t!r}); restoring positionally — "
+            "verify the model structure matches the save",
+            stacklevel=3)
 
 
 # BatchNormalization's debias ``count`` leaf (added r5; the layer keeps
@@ -420,21 +459,14 @@ def restore_sharded(directory: str, template, tag: Any = None,
     # by name, which requires the save and the template to use stable
     # layer names for the leaves they share.
     if saved_names is not None and len(saved_names) != len(tmpl_names):
-        by_name = {n: i for i, n in enumerate(saved_names)}
-        remap = []
-        for ti, name in enumerate(tmpl_names):
-            si = by_name.get(name)
-            if si is None and flat[ti] is not None:
-                d = _fill_default(name, flat[ti])
-                if d is None:
-                    raise ValueError(
-                        f"checkpoint {tag} has no leaf named {name!r} "
-                        "and no restore default is registered for it — "
-                        "model/optimizer structure changed since the "
-                        "save in a way restore cannot bridge")
-                defaults[ti] = d
-            remap.append(si)
+        pairs = _remap_by_name(tag, saved_names,
+                               list(zip(tmpl_names, flat)))
+        remap = [si for si, _ in pairs]
+        defaults = {ti: d for ti, (_, d) in enumerate(pairs)
+                    if d is not None}
     else:
+        if saved_names is not None and saved_names != tmpl_names:
+            _warn_positional_name_drift(tag, saved_names, tmpl_names)
         remap = list(range(len(flat)))
     # index every entry key by leaf (npz members load lazily, so this
     # only reads the zip directories), then assemble + place ONE leaf at
