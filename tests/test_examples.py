@@ -58,6 +58,22 @@ class TestExamples:
         assert "transformer lm example done" in out
         assert "next-token accuracy" in out
 
+    def test_char_lm_on_real_source(self):
+        """The text-generation family on REAL data (the repo's own
+        source): short training must already compress well below the
+        uniform-distribution bits/char, and generate() must produce a
+        sample through the KV-cache path."""
+        out = run_example(
+            "examples/textgeneration/char_lm_source.py",
+            "--epochs", "2", "--limit-seqs", "1024", "--max-new", "60")
+        assert "char lm on real source done" in out
+        import re as _re
+        m = _re.search(r"bits/char (\d+\.\d+) \(uniform (\d+\.\d+)\)",
+                       out)
+        assert m, out[-500:]
+        bpc, uniform = float(m.group(1)), float(m.group(2))
+        assert bpc < uniform - 1.0, (bpc, uniform)
+
     def test_lenet_train_then_evaluate(self, tmp_path):
         ckpt = str(tmp_path / "ckpt")
         run_example("examples/lenet/train_lenet.py", "--epochs", "1",
