@@ -93,6 +93,19 @@ def name_scope(scope: str):
         _SCOPE_STACK.pop()
 
 
+def pop_base_flags(config: dict) -> tuple:
+    """Remove the base-``Layer``-managed attributes from a config dict.
+    Every ``from_config`` (the base one and wrapper overrides that call
+    ``cls(**config)`` themselves) must pop these — subclass __init__s
+    don't take **kwargs, so a leftover key is a TypeError."""
+    return config.pop("trainable", True), config.pop("remat", False)
+
+
+def set_base_flags(obj: "Layer", flags: tuple) -> "Layer":
+    obj.trainable, obj.remat = flags
+    return obj
+
+
 class Layer:
     """Base class for all layers.
 
@@ -114,6 +127,15 @@ class Layer:
             shape_utils.to_batch_shape(input_shape) if input_shape else None
         )
         self.trainable = kwargs.pop("trainable", True)
+        # remat=True wraps this layer's training-mode application in
+        # jax.checkpoint: its internal activations are recomputed during
+        # the backward pass instead of saved — the standard FLOPs-for-
+        # HBM trade for long-context / deep stacks.  Exact, not an
+        # approximation.  Honored by the GRAPH EXECUTOR (core/graph.py)
+        # for the layer at a graph node: a layer nested INSIDE a wrapper
+        # (TimeDistributed/Bidirectional) is applied by the wrapper, not
+        # the executor, so set remat on the wrapper itself.
+        self.remat = kwargs.pop("remat", False)
         if kwargs:
             raise TypeError(f"{type(self).__name__}: unexpected kwargs {kwargs}")
 
@@ -160,16 +182,16 @@ class Layer:
             # persist freezes (fine-tuned models reload still frozen);
             # omitted when True so existing configs stay byte-stable
             cfg["trainable"] = False
+        if self.remat:
+            cfg["remat"] = True  # omitted when False (byte-stability)
         return cfg
 
     @classmethod
     def from_config(cls, config: dict) -> "Layer":
         config = dict(config)
-        # handled here because subclass __init__s don't take **kwargs
-        trainable = config.pop("trainable", True)
+        flags = pop_base_flags(config)
         obj = cls(**config)
-        obj.trainable = trainable
-        return obj
+        return set_base_flags(obj, flags)
 
     def __repr__(self):
         return f"<{type(self).__name__} {self.name}>"

@@ -395,8 +395,11 @@ class TimeDistributed(Layer):
 
     @classmethod
     def from_config(cls, config):
-        from .....core.module import get_layer_class
+        from .....core.module import (get_layer_class, pop_base_flags,
+                                      set_base_flags)
+        config = dict(config)
         inner = config.pop("layer")
+        flags = pop_base_flags(config)
         layer = get_layer_class(inner["class_name"]).from_config(
             inner["config"])
-        return cls(layer=layer, **config)
+        return set_base_flags(cls(layer=layer, **config), flags)
