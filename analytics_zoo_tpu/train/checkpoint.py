@@ -138,24 +138,30 @@ def restore_checkpoint(directory: str, template, tag: Any = None):
     leaves = [data[f"arr_{i}"] for i in range(len(data.files))]
     flat_np, treedef = jax.tree_util.tree_flatten_with_path(template)
     flat = [leaf for _, leaf in flat_np]
-    if len(flat) != len(leaves):
-        # structure evolution (same bridge as restore_sharded): the
-        # flat manifest records leaf names — match by name and fill
-        # registered post-save leaves (e.g. BatchNormalization's debias
-        # ``count``) from RESTORE_DEFAULTS
-        saved_names = None
-        manifest_path = os.path.join(directory, f"ckpt_{tag}.json")
-        if os.path.exists(manifest_path):
-            with open(manifest_path) as f:
-                saved_names = json.load(f).get("names")
-        if saved_names is None or len(saved_names) != len(leaves):
+    tmpl_named = [(_path_name(p), tmpl) for p, tmpl in flat_np]
+    saved_names = None
+    manifest_path = os.path.join(directory, f"ckpt_{tag}.json")
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            saved_names = json.load(f).get("names")
+    names_usable = (saved_names is not None
+                    and len(saved_names) == len(leaves))
+    if (len(flat) != len(leaves)
+            or (names_usable
+                and saved_names != [n for n, _ in tmpl_named])):
+        # name drift or structure evolution — same name/shape matcher
+        # as restore_sharded (blind positional loading is unsafe even
+        # at equal counts: lexicographic dict flattening flips leaf
+        # order when auto-numbered names cross a digit boundary)
+        if not names_usable:
             raise ValueError(
                 f"Checkpoint has {len(leaves)} leaves, template has "
                 f"{len(flat)} (and no usable name manifest to bridge)")
-        tmpl_named = [(_path_name(p), tmpl) for p, tmpl in flat_np]
+        pairs = _remap_by_name(tag, saved_names,
+                               [np.shape(l) for l in leaves],
+                               tmpl_named)
         leaves = [leaves[si] if si is not None else d
-                  for si, d in _remap_by_name(tag, saved_names,
-                                              tmpl_named)]
+                  for si, d in pairs]
     for tmpl, loaded in zip(flat, leaves):
         if np.shape(tmpl) != loaded.shape:
             raise ValueError(
@@ -212,30 +218,66 @@ def _fill_default(name, tmpl):
     return None
 
 
-def _remap_by_name(tag, saved_names, tmpl_named):
-    """The structure-evolution bridge shared by both restore formats.
+def _remap_by_name(tag, saved_names, saved_shapes, tmpl_named):
+    """The name/shape-aware leaf matcher shared by both restore formats.
 
-    ``tmpl_named`` is [(name, template_leaf)].  Returns a parallel list
-    of (saved_index, default): exactly one of the pair is non-None —
-    the saved leaf to load, or the registered-default fill for a leaf
-    added after the save.  Raises for an unbridgeable absence."""
-    by_name = {n: i for i, n in enumerate(saved_names)}
+    ``tmpl_named`` is [(name, template_leaf)]; ``saved_shapes`` aligns
+    with ``saved_names`` (None for structural-None leaves).  Matching,
+    per template leaf:
+
+    1. its ordinal within its (auto-number-stripped name, shape) group,
+       both sides ordered by NATURAL numeric-suffix sort — the only
+       identity stable across builds (see the comment below);
+    2. a registered RESTORE_DEFAULT (a leaf added after the save);
+    3. otherwise fail loudly.
+
+    Returns a list of (saved_index, default) pairs — at most one of
+    each pair is non-None."""
+    # Group BOTH sides by (auto-number-stripped name, shape) and pair
+    # group members ordinally under NATURAL (numeric-suffix) sort.
+    # Exact-name matching is deliberately NOT given precedence: an
+    # auto-numbered name is not a stable identity across builds — two
+    # builds whose counters overlap can give the same name to different
+    # layers, and lexicographic manifest order flips at digit
+    # boundaries, so only (stripped name, shape, ordinal-in-group) is
+    # build-stable.  For stable user-assigned names (groups of one, or
+    # consistently numbered like attn_0/attn_1) ordinal pairing reduces
+    # to exact matching.
+    pool: dict = {}
+    for i, (n, sh) in enumerate(zip(saved_names, saved_shapes)):
+        if sh is not None:
+            pool.setdefault((_strip_auto_numbers(n), tuple(sh)),
+                            []).append(i)
+    for members in pool.values():
+        members.sort(key=lambda i: _natural_key(saved_names[i]))
+    tgroups: dict = {}
+    for ti, (name, tmpl) in enumerate(tmpl_named):
+        if tmpl is not None:
+            tgroups.setdefault(
+                (_strip_auto_numbers(name), tuple(np.shape(tmpl))),
+                []).append(ti)
+    assign: dict = {}
+    for key, tpos in tgroups.items():
+        tpos.sort(key=lambda ti: _natural_key(tmpl_named[ti][0]))
+        for ti, si in zip(tpos, pool.get(key, [])):
+            assign[ti] = si
     out = []
-    for name, tmpl in tmpl_named:
-        si = by_name.get(name)
-        if si is not None:
-            out.append((si, None))
-            continue
+    for ti, (name, tmpl) in enumerate(tmpl_named):
         if tmpl is None:  # structural None carries no data
             out.append((None, None))
+            continue
+        si = assign.get(ti)
+        if si is not None:
+            out.append((si, None))
             continue
         d = _fill_default(name, tmpl)
         if d is None:
             raise ValueError(
-                f"checkpoint {tag} has no leaf named {name!r} and no "
-                "restore default is registered for it — model/optimizer "
-                "structure changed since the save in a way restore "
-                "cannot bridge")
+                f"checkpoint {tag} has no leaf matching {name!r} "
+                f"(shape {np.shape(tmpl)}) by stripped-name+shape, and "
+                "no restore default is registered for it — model/"
+                "optimizer structure changed since the save in a way "
+                "restore cannot bridge")
         out.append((None, d))
     return out
 
@@ -247,21 +289,18 @@ def _strip_auto_numbers(name: str) -> str:
                     for part in name.split("/"))
 
 
-def _warn_positional_name_drift(tag, saved_names, tmpl_names):
-    """Equal leaf counts restore positionally; when the names disagree
-    beyond auto-number drift the load may still be wrong (a same-shape
-    leaf swapped for a semantically different one) — surface it."""
-    mismatched = [(s, t) for s, t in zip(saved_names, tmpl_names)
-                  if _strip_auto_numbers(s) != _strip_auto_numbers(t)]
-    if mismatched:
-        import warnings
-        s, t = mismatched[0]
-        warnings.warn(
-            f"checkpoint {tag}: {len(mismatched)} leaf name(s) disagree "
-            f"with the template beyond layer auto-numbering (first: "
-            f"saved {s!r} vs template {t!r}); restoring positionally — "
-            "verify the model structure matches the save",
-            stacklevel=3)
+def _natural_key(name: str):
+    """Sort key ordering auto-numbered path components NUMERICALLY
+    (construction order): dense_9 < dense_10 < dense_11, which
+    lexicographic string order violates at digit boundaries."""
+    key = []
+    for part in name.split("/"):
+        m = re.match(r"(.*?)_(\d+)$", part)
+        if m:
+            key.append((m.group(1), int(m.group(2))))
+        else:
+            key.append((part, -1))
+    return key
 
 
 # BatchNormalization's debias ``count`` leaf (added r5; the layer keeps
@@ -452,21 +491,22 @@ def restore_sharded(directory: str, template, tag: Any = None,
     saved_names = manifest.get("names")
     tmpl_names = _leaf_names(template)
     defaults: dict = {}
-    # equal leaf counts => positional (the normal resume path; auto-
-    # numbered layer names routinely drift between two builds of the
-    # same model, so name equality is NOT required).  A count mismatch
-    # means the structure genuinely changed since the save — then match
-    # by name, which requires the save and the template to use stable
-    # layer names for the leaves they share.
-    if saved_names is not None and len(saved_names) != len(tmpl_names):
-        pairs = _remap_by_name(tag, saved_names,
+    # identical names => identity mapping (the common resume).  ANY
+    # name drift goes through the name/shape matcher: auto-numbered
+    # names drift between two builds of the same model, and because
+    # dict keys flatten lexicographically, crossing a digit boundary
+    # (dense_99 -> dense_100) even flips leaf ORDER — blind positional
+    # loading would put weights in the wrong layers (caught live as a
+    # broadcast error, r5).
+    if saved_names is not None and saved_names != tmpl_names:
+        saved_shapes = (manifest.get("shapes")
+                        or [None] * len(saved_names))
+        pairs = _remap_by_name(tag, saved_names, saved_shapes,
                                list(zip(tmpl_names, flat)))
         remap = [si for si, _ in pairs]
         defaults = {ti: d for ti, (_, d) in enumerate(pairs)
                     if d is not None}
     else:
-        if saved_names is not None and saved_names != tmpl_names:
-            _warn_positional_name_drift(tag, saved_names, tmpl_names)
         remap = list(range(len(flat)))
     # index every entry key by leaf (npz members load lazily, so this
     # only reads the zip directories), then assemble + place ONE leaf at

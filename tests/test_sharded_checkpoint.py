@@ -268,3 +268,67 @@ def test_flat_restore_fills_post_save_state_leaf_by_name(tmp_path):
     assert np.isinf(out["model_state"]["bn"]["count"])
     np.testing.assert_array_equal(out["params"]["d"]["W"],
                                   np.ones((2, 2)))
+
+
+def test_restore_survives_autonumber_digit_boundary_flip(tmp_path):
+    """Dict keys flatten lexicographically, so auto-numbered layer names
+    crossing a digit boundary flip leaf ORDER: a save from a build with
+    dense_99+dense_100 lists the 100 BEFORE the 99, while the restoring
+    build's dense_101+dense_102 keep construction order. Blind
+    positional loading puts weights in the wrong layers (caught live as
+    a broadcast error, r5); the name/shape matcher must place them
+    correctly in BOTH formats."""
+    from analytics_zoo_tpu.train.checkpoint import (restore_checkpoint,
+                                                    restore_sharded,
+                                                    save_checkpoint,
+                                                    save_sharded)
+    w_big = np.arange(32, dtype=np.float32).reshape(8, 4)
+    w_small = np.arange(8, dtype=np.float32).reshape(4, 2)
+    # saved build: auto-numbers straddle the 2->3 digit boundary, so
+    # flatten order is [dense_100 (small), dense_99 (big)]
+    saved = {"params": {"dense_99": {"W": w_big},
+                        "dense_100": {"W": w_small}}}
+    # restoring build: same model, later counter — order [big, small]
+    template = {"params": {"dense_101": {"W": np.zeros((8, 4),
+                                                       np.float32)},
+                           "dense_102": {"W": np.zeros((4, 2),
+                                                       np.float32)}}}
+    save_checkpoint(str(tmp_path / "flat"), 1, saved)
+    out = restore_checkpoint(str(tmp_path / "flat"), template, 1)
+    np.testing.assert_array_equal(out["params"]["dense_101"]["W"], w_big)
+    np.testing.assert_array_equal(out["params"]["dense_102"]["W"],
+                                  w_small)
+
+    save_sharded(str(tmp_path / "sh"), 1, saved)
+    out = restore_sharded(str(tmp_path / "sh"), template, 1)
+    np.testing.assert_array_equal(out["params"]["dense_101"]["W"], w_big)
+    np.testing.assert_array_equal(out["params"]["dense_102"]["W"],
+                                  w_small)
+
+
+def test_restore_same_shape_stack_keeps_construction_order(tmp_path):
+    """A stack of SAME-shape auto-numbered layers (the transformer-block
+    case) must restore in construction order even when (a) the saved
+    names straddle a digit boundary (lexicographic flatten lists
+    dense_10 before dense_9) and (b) the two builds' auto-number ranges
+    OVERLAP (saved dense_10 and template dense_10 are different
+    layers)."""
+    from analytics_zoo_tpu.train.checkpoint import (restore_checkpoint,
+                                                    save_checkpoint)
+    a = np.full((4, 4), 1.0, np.float32)
+    b = np.full((4, 4), 2.0, np.float32)
+    c = np.full((4, 4), 3.0, np.float32)
+    saved = {"params": {"dense_9": {"W": a}, "dense_10": {"W": b},
+                        "dense_11": {"W": c}}}
+    # overlapping range: template's FIRST layer is named dense_10
+    template = {"params": {"dense_10": {"W": np.zeros((4, 4),
+                                                      np.float32)},
+                           "dense_11": {"W": np.zeros((4, 4),
+                                                      np.float32)},
+                           "dense_12": {"W": np.zeros((4, 4),
+                                                      np.float32)}}}
+    save_checkpoint(str(tmp_path), 1, saved)
+    out = restore_checkpoint(str(tmp_path), template, 1)
+    np.testing.assert_array_equal(out["params"]["dense_10"]["W"], a)
+    np.testing.assert_array_equal(out["params"]["dense_11"]["W"], b)
+    np.testing.assert_array_equal(out["params"]["dense_12"]["W"], c)
