@@ -803,11 +803,41 @@ def _bench_attention(jax, jnp, on_tpu: bool):
                 return jnp.sum(o.astype(jnp.float32))
             return jax.jit(run)
 
+        # fwd+bwd: the custom-VJP backward (pallas dq and dk/dv
+        # kernels) carries ~2/3 of training attention FLOPs and was
+        # never independently measured before r5.  Loss chains q so the
+        # scan can't be elided; grad flops ~= 2.5x fwd (dq + dkv).
+        def many_grad(fn):
+            # grad w.r.t. ALL of q/k/v: the pallas custom-VJP always
+            # runs its dq and dk/dv kernels, and XLA autodiff must be
+            # made to compute the same full backward for a fair A/B.
+            # All three grads fold into the carry so none can be elided
+            # (sq == sk at these shapes, so the shapes line up).
+            def run(q, k, v):
+                def step(c, _):
+                    dq, dk, dv = jax.grad(
+                        lambda qq, kk, vv: jnp.sum(
+                            fn(qq, kk, vv).astype(jnp.float32)),
+                        argnums=(0, 1, 2))(c, k, v)
+                    return c + (dq + dk + dv).astype(c.dtype), ()
+                o, _ = lax.scan(step, q, None, length=iters)
+                return jnp.sum(o.astype(jnp.float32))
+            return jax.jit(run)
+
         variants = [("pallas", flash, (q, k, v)),
                     ("blockwise_xla", block, (q, k, v))]
         if on_tpu:  # the layout A/B is a TPU question; interpret mode
             # on the CPU fallback would double a already-slow section
             variants.insert(1, ("pallas_bhsd", many_bhsd(), (qh, kh, vh)))
+            variants += [
+                ("pallas_fwd_bwd", many_grad(
+                    lambda q, k, v: flash_attention(q, k, v, causal=True)),
+                 (q, k, v)),
+                ("blockwise_fwd_bwd", many_grad(
+                    lambda q, k, v: blockwise_attention(q, k, v,
+                                                        causal=True)),
+                 (q, k, v)),
+            ]
         for name, fn, args in variants:
             _ = float(fn(*args))  # compile + sync
             best = 1e9
@@ -815,13 +845,20 @@ def _bench_attention(jax, jnp, on_tpu: bool):
                 t0 = time.time()
                 _ = float(fn(*args))
                 best = min(best, (time.time() - t0) / iters)
-            entry[name] = {"tflops": round(flops / best / 1e12, 2),
+            # attention backward ~= 2.5x forward FLOPs (dq + dkv
+            # replay); count them so fwd_bwd TFLOP/s is comparable
+            used = flops * (3.5 if name.endswith("fwd_bwd") else 1.0)
+            entry[name] = {"tflops": round(used / best / 1e12, 2),
                            "ms": round(best * 1e3, 3)}
             _log(f"attention {b}x{s}x{h}x{d} {name}: "
                  f"{entry[name]['tflops']} TFLOP/s")
         entry["pallas_vs_blockwise"] = round(
             entry["pallas"]["tflops"]
             / max(entry["blockwise_xla"]["tflops"], 1e-9), 3)
+        if "pallas_fwd_bwd" in entry:
+            entry["bwd_pallas_vs_blockwise"] = round(
+                entry["pallas_fwd_bwd"]["tflops"]
+                / max(entry["blockwise_fwd_bwd"]["tflops"], 1e-9), 3)
         if "pallas_bhsd" in entry:
             entry["bhsd_vs_bshd"] = round(
                 entry["pallas_bhsd"]["tflops"]
