@@ -839,12 +839,22 @@ def _bench_attention(jax, jnp, on_tpu: bool):
                  (q, k, v)),
             ]
         for name, fn, args in variants:
-            _ = float(fn(*args))  # compile + sync
-            best = 1e9
-            for _ in range(3):
-                t0 = time.time()
-                _ = float(fn(*args))
-                best = min(best, (time.time() - t0) / iters)
+            # per-variant isolation: one variant failing to lower or
+            # fit VMEM (e.g. the fwd_bwd dkv kernel at long seq) must
+            # not void the others' measurements
+            try:
+                _ = float(fn(*args))  # compile + sync
+                best = 1e9
+                for _ in range(3):
+                    t0 = time.time()
+                    _ = float(fn(*args))
+                    best = min(best, (time.time() - t0) / iters)
+            except Exception as e:
+                entry[name] = {"error": f"{type(e).__name__}: "
+                                        f"{str(e)[:300]}"}
+                _log(f"attention {b}x{s}x{h}x{d} {name} FAILED: "
+                     f"{type(e).__name__}")
+                continue
             # attention backward ~= 2.5x forward FLOPs (dq + dkv
             # replay); count them so fwd_bwd TFLOP/s is comparable
             used = flops * (3.5 if name.endswith("fwd_bwd") else 1.0)
@@ -852,22 +862,30 @@ def _bench_attention(jax, jnp, on_tpu: bool):
                            "ms": round(best * 1e3, 3)}
             _log(f"attention {b}x{s}x{h}x{d} {name}: "
                  f"{entry[name]['tflops']} TFLOP/s")
-        entry["pallas_vs_blockwise"] = round(
-            entry["pallas"]["tflops"]
-            / max(entry["blockwise_xla"]["tflops"], 1e-9), 3)
+        def _ratio(a, b_):
+            ta = entry.get(a, {}).get("tflops")
+            tb = entry.get(b_, {}).get("tflops")
+            return (round(ta / max(tb, 1e-9), 3)
+                    if ta is not None and tb is not None else None)
+
+        entry["pallas_vs_blockwise"] = _ratio("pallas", "blockwise_xla")
         if "pallas_fwd_bwd" in entry:
-            entry["bwd_pallas_vs_blockwise"] = round(
-                entry["pallas_fwd_bwd"]["tflops"]
-                / max(entry["blockwise_fwd_bwd"]["tflops"], 1e-9), 3)
+            entry["bwd_pallas_vs_blockwise"] = _ratio(
+                "pallas_fwd_bwd", "blockwise_fwd_bwd")
         if "pallas_bhsd" in entry:
-            entry["bhsd_vs_bshd"] = round(
-                entry["pallas_bhsd"]["tflops"]
-                / max(entry["pallas"]["tflops"], 1e-9), 3)
-        # numerics cross-check
-        ref = blockwise_attention(q, k, v, causal=True)
-        got = flash_attention(q, k, v, causal=True, interpret=not on_tpu)
-        entry["max_abs_diff_vs_blockwise"] = round(float(jnp.max(jnp.abs(
-            ref.astype(jnp.float32) - got.astype(jnp.float32)))), 4)
+            entry["bhsd_vs_bshd"] = _ratio("pallas_bhsd", "pallas")
+        # numerics cross-check — same isolation as the variants: a
+        # kernel that failed above must not void this shape's entry
+        try:
+            ref = blockwise_attention(q, k, v, causal=True)
+            got = flash_attention(q, k, v, causal=True,
+                                  interpret=not on_tpu)
+            entry["max_abs_diff_vs_blockwise"] = round(float(jnp.max(
+                jnp.abs(ref.astype(jnp.float32)
+                        - got.astype(jnp.float32)))), 4)
+        except Exception as e:
+            entry["max_abs_diff_vs_blockwise"] = (
+                f"{type(e).__name__}: {str(e)[:200]}")
         out["shapes"].append(entry)
     return out
 
