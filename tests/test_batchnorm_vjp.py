@@ -181,3 +181,34 @@ def test_inference_stats_are_debiased():
     ref0 = np.asarray(x) / np.sqrt(1.0 + layer.epsilon)
     np.testing.assert_allclose(np.asarray(out0), ref0, rtol=1e-4,
                                atol=1e-4)
+
+
+def test_deep_bn_stack_short_training_evaluates_sanely():
+    """The r5 debias in the FULL fit/evaluate path: a deep stack of BN
+    layers trained for only ~100 steps must evaluate near its training
+    accuracy.  Pre-debias, init-weighted moving stats compounded through
+    the stack and a converged mobilenet evaluated at chance (0.11 vs
+    0.99 train)."""
+    import analytics_zoo_tpu as zoo
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        BatchNormalization, Dense)
+    zoo.init_nncontext()
+    rng = np.random.default_rng(0)
+    # separable blobs
+    centers = rng.normal(0, 3.0, (4, 16))
+    y = rng.integers(0, 4, 512).astype(np.int32)
+    x = (centers[y] + rng.normal(0, 0.5, (512, 16))).astype(np.float32)
+
+    m = Sequential()
+    m.add(Dense(32, activation="relu", input_shape=(16,)))
+    for _ in range(6):
+        m.add(BatchNormalization())
+        m.add(Dense(32, activation="relu"))
+    m.add(Dense(4, activation="softmax"))
+    m.compile({"name": "adam", "lr": 2e-3},
+              "sparse_categorical_crossentropy", metrics=["accuracy"])
+    hist = m.fit(x, y, batch_size=64, nb_epoch=12)   # ~96 steps
+    assert hist["loss"][-1] < 0.2, hist["loss"][-1]
+    acc = m.evaluate(x, y, batch_size=128)["accuracy"]
+    assert acc > 0.9, f"deep-BN eval collapsed: {acc}"
