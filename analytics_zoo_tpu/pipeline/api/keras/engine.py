@@ -407,7 +407,7 @@ class KerasNet(Layer):
     def load_model(path: str) -> "KerasNet":
         with open(os.path.join(path, "architecture.json")) as f:
             arch = json.load(f)
-        cls = _MODEL_CLASSES[arch["class_name"]]
+        cls = resolve_model_class(arch["class_name"])
         model = cls.from_config(arch["config"])
         weights_dir = os.path.join(path, "weights")
         if os.path.isdir(weights_dir):
@@ -682,6 +682,18 @@ class Model(KerasNet):
 
 
 _MODEL_CLASSES = {"Sequential": Sequential, "Model": Model}
+
+
+def resolve_model_class(name: str):
+    """Model-class lookup for every load path (KerasNet.load_model,
+    NNModel.load).  Zoo families register on models-package import — a
+    cold process that loads a save before ever importing the zoo must
+    not KeyError on registration order, so the unknown-name path
+    imports it on demand (same pattern as get_layer_class's keras2
+    on-demand import)."""
+    if name not in _MODEL_CLASSES:
+        import analytics_zoo_tpu.models  # noqa: F401
+    return _MODEL_CLASSES[name]
 
 
 def load_model(path: str) -> KerasNet:

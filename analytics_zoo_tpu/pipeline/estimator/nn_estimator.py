@@ -291,15 +291,15 @@ class NNModel(_Params):
     @classmethod
     def load(cls, path: str) -> "NNModel":
         import json
-        from ..api.keras.engine import _MODEL_CLASSES
+        from ..api.keras.engine import resolve_model_class
         from ...core.module import get_layer_class
         with open(os.path.join(path, "nnmodel.json")) as f:
             meta = json.load(f)
         mcls_name = meta["model"]["class_name"]
-        if mcls_name in _MODEL_CLASSES:
-            model = _MODEL_CLASSES[mcls_name].from_config(
+        try:
+            model = resolve_model_class(mcls_name).from_config(
                 meta["model"]["config"])
-        else:
+        except KeyError:
             model = get_layer_class(mcls_name).from_config(
                 meta["model"]["config"])
         klass = NNClassifierModel if meta["class_name"] == \

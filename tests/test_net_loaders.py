@@ -139,3 +139,50 @@ def test_load_torch_without_net_still_guides():
 def test_load_caffe_still_stub():
     with pytest.raises(NotImplementedError, match="Caffe"):
         Net.load_caffe("a.prototxt", "b.caffemodel")
+
+
+def test_net_load_zoo_model_in_fresh_process(tmp_path):
+    """Net.load of a ZOO-family save (ImageClassifier et al.) must work
+    in a process that never imported analytics_zoo_tpu.models — family
+    classes register on models-package import, and load_model imports
+    it on demand when the class is unknown (a cold serving process is
+    exactly this situation)."""
+    import subprocess
+    import sys
+    save = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+import analytics_zoo_tpu as zoo
+zoo.init_nncontext()
+from analytics_zoo_tpu.models import ImageClassifier
+m = ImageClassifier("squeezenet", input_shape=(32, 32, 1), num_classes=3)
+m.ensure_inference_ready()
+m.save_model({str(tmp_path / 'm')!r})
+print("SAVED")
+"""
+    load = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+import sys
+import analytics_zoo_tpu as zoo
+zoo.init_nncontext()
+assert "analytics_zoo_tpu.models" not in sys.modules, "premature import"
+from analytics_zoo_tpu.pipeline.api.net import Net
+net = Net.load({str(tmp_path / 'm')!r})
+import numpy as np
+p = np.asarray(net.predict(np.zeros((2, 32, 32, 1), np.float32),
+                           batch_size=2))
+assert p.shape == (2, 3), p.shape
+print("LOADED", type(net).__name__)
+"""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    for script, marker in [(save, "SAVED"), (load, "LOADED")]:
+        proc = subprocess.run([sys.executable, "-c", script],
+                              capture_output=True, text=True,
+                              timeout=420, env=env)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        assert marker in proc.stdout

@@ -26,12 +26,19 @@ class TestPrimitives:
         assert np.all(err <= bound)
 
     def test_dynamic_quantize(self):
-        x = jnp.asarray([[-3.0, 0.0, 1.5]])
+        # scales are PER SAMPLE (keepdims): row 0's outlier must not
+        # widen row 1's window
+        x = jnp.asarray([[-30.0, 0.0, 1.5], [-3.0, 0.0, 1.5]])
         xq, s = dynamic_quantize(x)
         assert xq.dtype == jnp.int8
+        assert s.shape == (2, 1)
         np.testing.assert_allclose(np.asarray(xq, np.float32) * s, x,
-                                   atol=float(s))
-        assert int(np.abs(np.asarray(xq)).max()) == 127
+                                   atol=float(np.max(s)))
+        # each row saturates at its own absmax
+        np.testing.assert_array_equal(
+            np.abs(np.asarray(xq)).max(axis=1), [127, 127])
+        np.testing.assert_allclose(np.asarray(s)[:, 0],
+                                   [30.0 / 127, 3.0 / 127], rtol=1e-6)
 
     def test_int8_matmul_close_to_float(self):
         rs = np.random.RandomState(1)
