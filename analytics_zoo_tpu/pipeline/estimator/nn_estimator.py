@@ -297,11 +297,10 @@ class NNModel(_Params):
             meta = json.load(f)
         mcls_name = meta["model"]["class_name"]
         try:
-            model = resolve_model_class(mcls_name).from_config(
-                meta["model"]["config"])
+            mcls = resolve_model_class(mcls_name)
         except KeyError:
-            model = get_layer_class(mcls_name).from_config(
-                meta["model"]["config"])
+            mcls = get_layer_class(mcls_name)
+        model = mcls.from_config(meta["model"]["config"])
         klass = NNClassifierModel if meta["class_name"] == \
             "NNClassifierModel" else cls
         obj = klass(
