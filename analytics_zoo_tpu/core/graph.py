@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from . import shapes as shape_utils
-from .module import Layer, Params, State, fresh_name, register_layer, split_rng
+from .module import (Layer, Params, State, fresh_name, register_layer,
+                     remat_apply, split_rng)
 
 _NODE_IDS = itertools.count()
 
@@ -266,17 +267,8 @@ class GraphModule(Layer):
                 # gradients so the optimizer never moves these weights
                 p = jax.tree_util.tree_map(jax.lax.stop_gradient, p)
             s = state.get(layer.name, {})
-            if getattr(layer, "remat", False) and training:
-                # jax.checkpoint: save only this layer's boundary values,
-                # recompute its internals in the backward pass (exact —
-                # the FLOPs-for-HBM long-context trade; Layer(remat=...))
-                def _rematted(p_, s_, ins_, r_, _layer=layer):
-                    return _layer.apply(p_, s_, ins_, training=True,
-                                        rng=r_)
-                out, s_new = jax.checkpoint(_rematted)(p, s, ins, r)
-            else:
-                out, s_new = layer.apply(p, s, ins, training=training,
-                                         rng=r)
+            out, s_new = remat_apply(layer, p, s, ins, training=training,
+                                     rng=r)
             if layer.stateful and s_new:
                 prev = new_state.get(layer.name)
                 if (prev is not None and prev is not s

@@ -106,6 +106,29 @@ def set_base_flags(obj: "Layer", flags: tuple) -> "Layer":
     return obj
 
 
+def remat_apply(layer, params, state, inputs, training=False, rng=None,
+                force=False):
+    """Apply ``layer`` honoring its ``remat`` flag.
+
+    The graph executor routes every graph-node application through this
+    (core/graph.py), and WRAPPERS that apply an inner layer themselves
+    (TimeDistributed, Bidirectional) route the inner application through
+    it too — so a remat flag works wherever the layer sits, not only at
+    graph nodes.  ``force=True`` remats regardless of the layer's own
+    flag (Bidirectional extends the user's forward-layer flag to the
+    internally-built backward clone without clobbering a flag set on
+    the clone directly)."""
+    if (force or getattr(layer, "remat", False)) and training:
+        # jax.checkpoint: save only this layer's boundary values,
+        # recompute its internals in the backward pass (exact — the
+        # FLOPs-for-HBM long-context trade; Layer(remat=...))
+        def _rematted(p_, s_, ins_, r_):
+            return layer.apply(p_, s_, ins_, training=True, rng=r_)
+
+        return jax.checkpoint(_rematted)(params, state, inputs, rng)
+    return layer.apply(params, state, inputs, training=training, rng=rng)
+
+
 class Layer:
     """Base class for all layers.
 
@@ -131,10 +154,9 @@ class Layer:
         # jax.checkpoint: its internal activations are recomputed during
         # the backward pass instead of saved — the standard FLOPs-for-
         # HBM trade for long-context / deep stacks.  Exact, not an
-        # approximation.  Honored by the GRAPH EXECUTOR (core/graph.py)
-        # for the layer at a graph node: a layer nested INSIDE a wrapper
-        # (TimeDistributed/Bidirectional) is applied by the wrapper, not
-        # the executor, so set remat on the wrapper itself.
+        # approximation.  Honored via remat_apply() both at graph nodes
+        # (core/graph.py) and inside wrappers (TimeDistributed /
+        # Bidirectional route their inner application through it).
         self.remat = kwargs.pop("remat", False)
         if kwargs:
             raise TypeError(f"{type(self).__name__}: unexpected kwargs {kwargs}")

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from .....core import initializers
 from .....core import shapes as shape_utils
-from .....core.module import Layer, register_layer
+from .....core.module import Layer, register_layer, remat_apply
 from .. import regularizers
 from .. import activations
 
@@ -374,8 +374,8 @@ class TimeDistributed(Layer):
     def apply(self, params, state, inputs, training=False, rng=None):
         b, t = inputs.shape[0], inputs.shape[1]
         flat = inputs.reshape((b * t,) + inputs.shape[2:])
-        out, new_state = self.layer.apply(params, state, flat,
-                                          training=training, rng=rng)
+        out, new_state = remat_apply(self.layer, params, state, flat,
+                                     training=training, rng=rng)
         return out.reshape((b, t) + out.shape[1:]), new_state
 
     def call(self, params, state, inputs, training=False, rng=None):

@@ -21,7 +21,7 @@ from jax import lax
 
 from .....core import initializers
 from .....core import shapes as shape_utils
-from .....core.module import Layer, register_layer
+from .....core.module import Layer, register_layer, remat_apply
 from .. import activations
 
 
@@ -269,10 +269,15 @@ class Bidirectional(Layer):
         }
 
     def call(self, params, state, inputs, training=False, rng=None):
-        fwd = self.layer.call(params["forward"], {}, inputs,
-                              training=training, rng=rng)
-        bwd = self.backward_layer.call(params["backward"], {}, inputs,
-                                       training=training, rng=rng)
+        # the user's remat flag lives on the visible (forward) layer;
+        # the backward clone was built in __init__, possibly before the
+        # flag was set, so extend it via force= (a flag set directly on
+        # backward_layer is honored too, never clobbered)
+        fwd = remat_apply(self.layer, params["forward"], {}, inputs,
+                          training=training, rng=rng)[0]
+        bwd = remat_apply(self.backward_layer, params["backward"], {},
+                          inputs, training=training, rng=rng,
+                          force=self.layer.remat)[0]
         if self.layer.return_sequences:
             bwd = jnp.flip(bwd, axis=1)  # re-align timesteps
         if self.merge_mode == "concat":

@@ -218,6 +218,64 @@ def _fill_default(name, tmpl):
     return None
 
 
+# Structure-evolution escape hatch #2: a layer RENAMED in a later
+# version registers an old→new alias here (patterns run against the
+# auto-number-STRIPPED saved path).  Applied only to leaves the primary
+# name+shape matcher left unpaired, so a model that legitimately
+# contains both names is never hijacked.  An optional guard predicate
+# over (leftover saved stripped paths, unmatched template stripped
+# paths) scopes an alias to the exact migration signature — a too-broad
+# alias would turn the loud "structure changed" failure into a silent
+# wrong-weights load.
+def _component_in(names, component: str) -> bool:
+    pat = re.compile(rf"(^|/){component}(/|$)")
+    return any(pat.search(n) for n in names)
+
+
+def _lm_pre_generate_signature(leftover_saved, unmatched_tmpl) -> bool:
+    """Pre-generate() TransformerLM migration: the save carries BOTH
+    auto-named embedding layers unpaired, and the template is missing
+    BOTH of their current spellings.  A current model that merely uses
+    an auto-named PositionalEmbedding (a live exported layer) direct-
+    matches it, so its template has no unmatched pos_embed and the
+    aliases stay inert."""
+    return (_component_in(leftover_saved, "embedding")
+            and _component_in(leftover_saved, "positionalembedding")
+            and _component_in(unmatched_tmpl, "tok_embed")
+            and _component_in(unmatched_tmpl, "pos_embed"))
+
+
+RESTORE_RENAMES: list = [
+    # TransformerLM builds before the generate() release auto-named the
+    # two embedding layers; current builds use stable names
+    # (models/textgeneration.py: tok_embed / pos_embed).
+    (re.compile(r"(^|/)positionalembedding(/|$)"), r"\1pos_embed\2",
+     _lm_pre_generate_signature),
+    (re.compile(r"(^|/)embedding(/|$)"), r"\1tok_embed\2",
+     _lm_pre_generate_signature),
+]
+
+
+def register_restore_rename(pattern: str, replacement: str,
+                            guard=None) -> None:
+    """``pattern``/``replacement`` rewrite an OLD stripped leaf path to
+    its current spelling (re.sub semantics); optional
+    ``guard(leftover_saved, unmatched_tmpl)`` activates the alias only
+    when both sides carry the expected migration signature."""
+    RESTORE_RENAMES.insert(0, (re.compile(pattern), replacement, guard))
+
+
+def _apply_renames(stripped: str, active) -> str:
+    # first matching pattern wins: a later alias must not re-rewrite the
+    # TARGET of an earlier one (e.g. a user alias whose new spelling
+    # itself contains an "embedding" path segment)
+    for pat, repl in active:
+        renamed = pat.sub(repl, stripped)
+        if renamed != stripped:
+            return renamed
+    return stripped
+
+
 def _remap_by_name(tag, saved_names, saved_shapes, tmpl_named):
     """The name/shape-aware leaf matcher shared by both restore formats.
 
@@ -260,6 +318,31 @@ def _remap_by_name(tag, saved_names, saved_shapes, tmpl_named):
     for key, tpos in tgroups.items():
         tpos.sort(key=lambda ti: _natural_key(tmpl_named[ti][0]))
         for ti, si in zip(tpos, pool.get(key, [])):
+            assign[ti] = si
+    # second chance for RENAMED layers (RESTORE_RENAMES): run the alias
+    # table over the stripped names of saved leaves the primary pass
+    # left unconsumed, and pair them with still-unmatched template
+    # leaves the same ordinal way.  Leftovers only, so a model that
+    # contains both the old and the new name keeps its direct matches.
+    consumed = set(assign.values())
+    leftover_saved = {key[0] for key, members in pool.items()
+                      if any(i not in consumed for i in members)}
+    unmatched_tmpl = {key[0] for key, tpos in tgroups.items()
+                      if any(ti not in assign for ti in tpos)}
+    active = [r[:2] for r in RESTORE_RENAMES
+              if len(r) < 3 or r[2] is None
+              or r[2](leftover_saved, unmatched_tmpl)]
+    alias_pool: dict = {}
+    for (sname, shape), members in pool.items():
+        rest = [i for i in members if i not in consumed]
+        renamed = _apply_renames(sname, active)
+        if rest and renamed != sname:
+            alias_pool.setdefault((renamed, shape), []).extend(rest)
+    for members in alias_pool.values():
+        members.sort(key=lambda i: _natural_key(saved_names[i]))
+    for key, tpos in tgroups.items():
+        unmatched = [ti for ti in tpos if ti not in assign]
+        for ti, si in zip(unmatched, alias_pool.get(key, [])):
             assign[ti] = si
     out = []
     for ti, (name, tmpl) in enumerate(tmpl_named):

@@ -306,6 +306,76 @@ def test_restore_survives_autonumber_digit_boundary_flip(tmp_path):
                                   w_small)
 
 
+def test_restore_bridges_renamed_layers(tmp_path):
+    """A checkpoint saved under a layer's OLD name — TransformerLM's
+    pre-generate() ``embedding_1``/``positionalembedding_1`` vs today's
+    ``tok_embed``/``pos_embed`` — restores through the RESTORE_RENAMES
+    alias table.  Aliases run only over leaves the primary name+shape
+    matcher left unpaired, so models legitimately containing both
+    spellings keep their direct matches."""
+    from analytics_zoo_tpu.train.checkpoint import (restore_checkpoint,
+                                                    restore_sharded,
+                                                    save_checkpoint,
+                                                    save_sharded)
+    tok = np.arange(12, dtype=np.float32).reshape(4, 3)
+    pos = 10.0 * np.arange(6, dtype=np.float32).reshape(2, 3)
+    saved = {"params": {
+        "embedding_1": {"weights": tok},
+        "positionalembedding_1": {"weights": pos}}}
+    template = {"params": {
+        "tok_embed": {"weights": np.zeros((4, 3), np.float32)},
+        "pos_embed": {"weights": np.zeros((2, 3), np.float32)}}}
+    save_checkpoint(str(tmp_path / "flat"), 1, saved)
+    out = restore_checkpoint(str(tmp_path / "flat"), template, 1)
+    np.testing.assert_array_equal(out["params"]["tok_embed"]["weights"],
+                                  tok)
+    np.testing.assert_array_equal(out["params"]["pos_embed"]["weights"],
+                                  pos)
+    save_sharded(str(tmp_path / "sh"), 1, saved)
+    out = restore_sharded(str(tmp_path / "sh"), template, 1)
+    np.testing.assert_array_equal(out["params"]["tok_embed"]["weights"],
+                                  tok)
+
+    # a save with BOTH spellings present: the direct match wins — the
+    # alias pass never hijacks a template leaf the primary matcher
+    # already paired
+    both_saved = {"params": {
+        "embedding_1": {"weights": tok},
+        "positionalembedding_1": {"weights": pos},
+        "tok_embed": {"weights": 2.0 * tok}}}
+    both_tmpl = {"params": {
+        "tok_embed": {"weights": np.zeros((4, 3), np.float32)}}}
+    save_checkpoint(str(tmp_path / "both"), 1, both_saved)
+    out = restore_checkpoint(str(tmp_path / "both"), both_tmpl, 1)
+    np.testing.assert_array_equal(
+        out["params"]["tok_embed"]["weights"], 2.0 * tok)
+
+    # WITHOUT the full migration signature the aliases stay inert and
+    # structure drift keeps failing loudly.  (a) no positionalembedding
+    # sibling in the save; (b) a CURRENT model whose auto-named
+    # PositionalEmbedding direct-matches — its template has no
+    # unmatched pos_embed, so a leftover generic embedding leaf must
+    # not silently pair with a same-shape template leaf that happens to
+    # be named tok_embed.
+    loose_saved = {"params": {"embedding_1": {"weights": tok}}}
+    loose_tmpl = {"params": {
+        "tok_embed": {"weights": np.zeros((4, 3), np.float32)}}}
+    save_checkpoint(str(tmp_path / "loose"), 1, loose_saved)
+    with pytest.raises(ValueError, match="no restore default"):
+        restore_checkpoint(str(tmp_path / "loose"), loose_tmpl, 1)
+
+    live_saved = {"params": {
+        "positionalembedding_1": {"weights": pos},
+        "embedding_1": {"weights": tok}}}
+    live_tmpl = {"params": {
+        "positionalembedding_1": {"weights": np.zeros((2, 3),
+                                                      np.float32)},
+        "tok_embed": {"weights": np.zeros((4, 3), np.float32)}}}
+    save_checkpoint(str(tmp_path / "live"), 1, live_saved)
+    with pytest.raises(ValueError, match="no restore default"):
+        restore_checkpoint(str(tmp_path / "live"), live_tmpl, 1)
+
+
 def test_restore_same_shape_stack_keeps_construction_order(tmp_path):
     """A stack of SAME-shape auto-numbered layers (the transformer-block
     case) must restore in construction order even when (a) the saved
