@@ -517,6 +517,14 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     positions >= kv_lengths[b] are masked INSIDE the kernels (forward
     and both backward kernels), and whole key blocks beyond the length
     are skipped.  See ``naive_attention`` for the padded-query caveat.
+
+    Awkward (prime-ish) lengths with no block divisor >= 8 are handled
+    by padding q/k/v up to a 128-multiple: padded keys ride the same
+    kv_lengths masking, padded query rows are sliced off (their dout is
+    zero through the slice's VJP, so real dk/dv are exact).  The one
+    shape that still raises is causal attention at CROSS lengths
+    (sq != sk) with no usable divisor — equal padding would break the
+    q_pos = i + sk - sq alignment there.
     """
     if layout == "bshd":
         b, sq, h, d = q.shape
@@ -530,16 +538,41 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
     # clamp to the sequence, then fall back to the largest divisor so any
     # seq length that has a usable block works with the tuned defaults
     # (e.g. 384 % 256 != 0 → block_q 128)
+    cap_q, cap_k = block_q, block_k
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q:
         block_q = _largest_divisor(sq, block_q)
     if sk % block_k:
         block_k = _largest_divisor(sk, block_k)
+    pad_q = pad_k = 0
     if min(block_q, block_k) < 8:
+        # awkward (prime-ish) lengths: PAD up to a 128-multiple and
+        # mask.  Padded keys ride the kv_lengths kernel masking (scores
+        # masked, whole padded blocks skipped); padded query rows are
+        # sliced off the output, and the slice's VJP zero-fills their
+        # dout, so they contribute nothing to dk/dv of real keys.
+        # Causal alignment (q_pos = i + sk − sq) survives because both
+        # sides pad by the SAME amount — which requires sq == sk; the
+        # causal cross-length case keeps the loud error.
+        if causal and sq != sk:
+            raise ValueError(
+                f"causal flash attention at cross lengths (sq={sq}, "
+                f"sk={sk}) needs a block divisor >= 8 on both — use "
+                "blockwise/naive attention")
+        if block_q < 8 or (causal and block_k < 8):
+            pad_q = -sq % 128
+        if block_k < 8 or (causal and block_q < 8):
+            pad_k = -sk % 128
+        block_q = _largest_divisor(sq + pad_q, min(cap_q, sq + pad_q))
+        block_k = _largest_divisor(sk + pad_k, min(cap_k, sk + pad_k))
+    if min(block_q, block_k) < 8:
+        # only reachable via caller-supplied tiny block caps (padding
+        # guarantees a >= 128 divisor otherwise) — keep the loud error
+        # instead of handing the pallas kernel a sub-sublane tile
         raise ValueError(
-            f"seq lengths ({sq}, {sk}) have no usable block divisor — "
-            "use blockwise/naive attention for prime-ish lengths")
+            f"flash attention blocks (block_q={block_q}, "
+            f"block_k={block_k}) must be >= 8 (TPU sublane tiling)")
     if causal and sq > sk:
         # rows aligned before the first key are FULLY masked; their
         # backward replay (p = exp(s − lse)) would cancel the finite
@@ -563,14 +596,22 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 256,
         kf = k.reshape(b * h, sk, d)
         vf = v.reshape(b * h, sk, d)
 
-    masked = kv_lengths is not None
+    if pad_q or pad_k:
+        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
+        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
+    masked = kv_lengths is not None or pad_k > 0
     if masked:
-        # per-(batch·head) lengths, matching the b-major fold order
-        lens = jnp.repeat(_clamp_lengths(kv_lengths, sk), h)[:, None, None]
+        # per-(batch·head) lengths, matching the b-major fold order;
+        # clamped to the REAL key count so padded keys stay masked
+        base = (_clamp_lengths(kv_lengths, sk) if kv_lengths is not None
+                else jnp.full((b,), sk, jnp.float32))
+        lens = jnp.repeat(base, h)[:, None, None]
     else:
         lens = jnp.zeros((b * h, 1, 1), jnp.float32)  # inert placeholder
-    out = _flash_core(qf, kf, vf, lens, sq, sk, causal, masked, block_q,
-                      block_k, scale, interpret)
+    out = _flash_core(qf, kf, vf, lens, sq + pad_q, sk + pad_k, causal,
+                      masked, block_q, block_k, scale, interpret)
+    out = out[:, :sq] if pad_q else out
     if layout == "bshd":
         return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
     return out.reshape(b, h, sq, d)
@@ -582,6 +623,21 @@ def _largest_divisor(n: int, cap: int) -> int:
         if n % d == 0:
             return d
     return 1
+
+
+def _flash_supports(causal: bool, sq: int, sk: int) -> bool:
+    """Can ``flash_attention`` (at its default block caps) run this
+    shape?  Pad-and-mask covers every length except the causal CROSS
+    shapes: sq > sk has fully-masked rows, and sq != sk with no block
+    divisor >= 8 cannot pad both sides equally (the q_pos alignment).
+    The single eligibility predicate for both dispatchers — keep in
+    sync with flash_attention's internal raise."""
+    if causal and sq > sk:
+        return False
+    if causal and sq != sk and min(_largest_divisor(sq, 256),
+                                   _largest_divisor(sk, 1024)) < 8:
+        return False
+    return True
 
 
 def attention_bhsd(q, k, v, causal: bool = False,
@@ -597,17 +653,18 @@ def attention_bhsd(q, k, v, causal: bool = False,
     ``kv_lengths``: optional (batch,) valid key counts — right-padded
     batches mask keys past their length in every implementation."""
     sq, sk = q.shape[2], k.shape[2]
-    bq, bk = _largest_divisor(sq, 256), _largest_divisor(sk, 1024)
     on_tpu = jax.devices()[0].platform == "tpu"
     if implementation == "flash" or (
-            implementation == "auto" and on_tpu and min(bq, bk) >= 8
-            and not (causal and sq > sk)):
-        # explicit "flash" with no usable divisor RAISES inside
-        # flash_attention (never a silent O(S²) naive fallback)
-        return flash_attention(q, k, v, causal=causal, block_q=bq,
-                               block_k=bk, layout="bhsd",
+            implementation == "auto" and on_tpu
+            and _flash_supports(causal, sq, sk)):
+        # awkward lengths pad-and-mask inside flash_attention; the one
+        # unsupported shape (causal cross-length with no divisor)
+        # RAISES there on explicit "flash" (never a silent O(S²)
+        # naive fallback) and falls through to blockwise/naive on auto
+        return flash_attention(q, k, v, causal=causal, layout="bhsd",
                                interpret=not on_tpu,
                                kv_lengths=kv_lengths)
+    bq, bk = _largest_divisor(sq, 256), _largest_divisor(sk, 1024)
     qs, ks, vs = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
     if implementation == "blockwise" or (
             implementation == "auto" and min(bq, bk) >= 8):
@@ -623,19 +680,21 @@ def attention_bhsd(q, k, v, causal: bool = False,
 
 def attention(q, k, v, causal: bool = False, implementation: str = "auto",
               kv_lengths=None):
-    """Dispatch: pallas on TPU, blockwise elsewhere; awkward sequence
-    lengths (no usable block divisor) fall back to naive."""
+    """Dispatch: pallas on TPU (awkward lengths pad-and-mask inside
+    flash_attention), blockwise elsewhere; lengths with no usable block
+    divisor fall back to naive off-TPU (and for the causal cross-length
+    shape flash cannot pad)."""
     sq, sk = q.shape[1], k.shape[1]
     if implementation == "auto":
+        if (jax.devices()[0].platform == "tpu"
+                and _flash_supports(causal, sq, sk)):
+            return flash_attention(q, k, v, causal=causal,
+                                   kv_lengths=kv_lengths)
         bq, bk = _largest_divisor(sq, 256), _largest_divisor(sk, 1024)
         if min(bq, bk) < 8:
             # prime-ish lengths: blocked kernels degenerate, use naive
             return naive_attention(q, k, v, causal=causal,
                                    kv_lengths=kv_lengths)
-        if (jax.devices()[0].platform == "tpu"
-                and not (causal and sq > sk)):
-            return flash_attention(q, k, v, causal=causal, block_q=bq,
-                                   block_k=bk, kv_lengths=kv_lengths)
         return blockwise_attention(q, k, v, causal=causal, block_k=bk,
                                    kv_lengths=kv_lengths)
     if implementation == "flash":

@@ -101,6 +101,37 @@ def test_flash_causal_rejects_fully_masked_rows():
         rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal,sq,sk", [
+    (True, 127, 127),    # prime, equal (training shape)
+    (False, 127, 251),   # prime, cross (encoder cross-attention)
+    (False, 131, 64),    # awkward q only
+])
+def test_flash_pads_awkward_lengths_matches_naive(causal, sq, sk):
+    """Lengths with no block divisor >= 8 pad-and-mask inside
+    flash_attention (r5; formerly a ValueError) — forward AND backward
+    must match the naive oracle exactly, including with a kv_lengths
+    ragged batch on top."""
+    q = qkv(b=2, s=sq, h=2, d=16, seed=11)[0]
+    _, k, v = qkv(b=2, s=sk, h=2, d=16, seed=12)
+    for lens in (None, np.array([sk, max(1, sk // 3)])):
+        ref = naive_attention(q, k, v, causal=causal, kv_lengths=lens)
+        out = flash_attention(q, k, v, causal=causal, interpret=True,
+                              kv_lengths=lens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        # backward through the pad path too — a padded key block must
+        # contribute exactly zero dk/dv even when lens < sk
+        g = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=causal, interpret=True,
+            kv_lengths=lens) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gn = jax.grad(lambda q, k, v: jnp.sum(naive_attention(
+            q, k, v, causal=causal, kv_lengths=lens) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gn):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+
 def test_flash_backward_prime_key_length_keeps_fwd_block():
     """sk=1009 (prime): the backward must not degenerate to a
     per-element grid — it falls back to the forward's block size."""

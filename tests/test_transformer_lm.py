@@ -100,15 +100,24 @@ def test_class_nll_sequence_targets_batch_one():
     assert out3.shape == (1, 1) and np.isfinite(np.asarray(out3)).all()
 
 
-def test_attention_bhsd_explicit_flash_raises_on_bad_divisor():
-    """Explicit implementation='flash' with a prime-ish sequence must
-    raise, never silently fall back to O(S^2) naive."""
+def test_attention_bhsd_flash_pads_awkward_lengths():
+    """Explicit implementation='flash' with a prime-ish EQUAL-length
+    sequence pads-and-masks inside the kernel (r5) and matches naive;
+    the causal CROSS-length no-divisor shape still raises — never a
+    silent O(S^2) naive fallback."""
+    from analytics_zoo_tpu.ops.attention import naive_attention
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.normal(size=(1, 2, 7, 16)), jnp.float32)
-    with pytest.raises(ValueError, match="block divisor"):
-        attention_bhsd(q, q, q, causal=True, implementation="flash")
-    # auto on CPU with the same shape quietly uses naive (correct path)
-    out = attention_bhsd(q, q, q, causal=True)
+    out = attention_bhsd(q, q, q, causal=True, implementation="flash")
+    ref = naive_attention(*(a.transpose(0, 2, 1, 3) for a in (q, q, q)),
+                          causal=True).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    k = jnp.asarray(rng.normal(size=(1, 2, 13, 16)), jnp.float32)
+    with pytest.raises(ValueError, match="cross lengths"):
+        attention_bhsd(q, k, k, causal=True, implementation="flash")
+    # auto on CPU with the cross shape quietly uses naive (correct path)
+    out = attention_bhsd(q, k, k, causal=True)
     assert out.shape == q.shape
 
 
