@@ -67,6 +67,23 @@ def _mlp(bp, f):
         + bp["down"]["b"]
 
 
+def _head_logits(params, hidden):
+    """Final LN + lm_head over a (b, d) hidden state — the one logits
+    head both the sampling and beam builders share."""
+    x = _layer_norm(params["ln_final"], hidden)
+    return x @ params["lm_head"]["W"] + params["lm_head"]["b"]
+
+
+def _embed_token(params, tok, pos):
+    """Token + positional embedding for one decode step (tok: (rows,)
+    int ids, pos: scalar position)."""
+    emb = jnp.take(params["tok_embed"]["embeddings"],
+                   tok.astype(jnp.int32), axis=0)
+    return emb + lax.dynamic_index_in_dim(
+        params["pos_embed"]["table"], pos, keepdims=False).astype(
+        emb.dtype)
+
+
 def _prefill(params, hyper, prompt, cache_len):
     """Batched prompt pass: causal attention over the whole prompt in one
     forward (the training-shaped compute), writing each layer's K/V into
@@ -151,14 +168,11 @@ def build_generate_fn(hyper, s_p: int, max_new: int, temperature: float,
     config.  The scan carries the caches, so the whole decode is one
     XLA while-loop — no per-token host dispatch."""
     cache_len = s_p + max_new
-    pos_table_key = "pos_embed"
-    emb_key = "tok_embed"
 
     @jax.jit
     def run(params, prompt, rng):
         last_hidden, caches = _prefill(params, hyper, prompt, cache_len)
-        x = _layer_norm(params["ln_final"], last_hidden)
-        logits0 = x @ params["lm_head"]["W"] + params["lm_head"]["b"]
+        logits0 = _head_logits(params, last_hidden)
         rng0, rng_loop = jax.random.split(rng)
         tok0 = _sample(logits0, rng0, temperature, top_k)
 
@@ -166,11 +180,7 @@ def build_generate_fn(hyper, s_p: int, max_new: int, temperature: float,
             tok, caches, r = carry
             r, r_step = jax.random.split(r)
             pos = s_p + i
-            emb = jnp.take(params[emb_key]["embeddings"],
-                           tok.astype(jnp.int32), axis=0)
-            emb = emb + lax.dynamic_index_in_dim(
-                params[pos_table_key]["table"], pos, keepdims=False
-            ).astype(emb.dtype)
+            emb = _embed_token(params, tok, pos)
             logits, caches = _decode_step(params, hyper, caches, emb, pos)
             nxt = _sample(logits, r_step, temperature, top_k)
             return (nxt, caches, r), tok
@@ -182,9 +192,92 @@ def build_generate_fn(hyper, s_p: int, max_new: int, temperature: float,
     return run
 
 
+def build_beam_fn(hyper, s_p: int, max_new: int, beam_width: int):
+    """Compile one beam-search plan: (params, prompt) -> (tok0, toks,
+    parents, scores) for post-scan backtracking.  Deterministic (no
+    rng); beams ride the batch dimension (row b·W + w), so every decode
+    step stays one batched MXU computation, and each step's surviving
+    beams gather their parents' KV caches."""
+    cache_len = s_p + max_new
+    W = beam_width
+
+    @jax.jit
+    def run(params, prompt):
+        b = prompt.shape[0]
+        last_hidden, caches = _prefill(params, hyper, prompt, cache_len)
+        logits0 = _head_logits(params, last_hidden)
+        logp0 = jax.nn.log_softmax(logits0.astype(jnp.float32), axis=-1)
+        cum, tok0 = lax.top_k(logp0, W)  # (b, W)
+        # broadcast each cache row to its W beams (b-major: row b·W + w)
+        caches = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, W, axis=0), caches)
+
+        def step(carry, i):
+            tok, cum_lp, caches = carry  # (b, W), (b, W), (b·W, ...)
+            pos = s_p + i
+            emb = _embed_token(params, tok.reshape(b * W), pos)
+            logits, caches = _decode_step(params, hyper, caches, emb,
+                                          pos)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                      axis=-1)
+            V = logp.shape[-1]
+            total = cum_lp[:, :, None] + logp.reshape(b, W, V)
+            cum2, idx = lax.top_k(total.reshape(b, W * V), W)
+            parent = idx // V  # (b, W) surviving beams' ancestors
+            tok2 = idx % V
+            brow = jnp.arange(b)[:, None]
+            caches = jax.tree_util.tree_map(
+                lambda c: c.reshape(b, W, *c.shape[1:])[brow, parent]
+                .reshape(b * W, *c.shape[1:]), caches)
+            return (tok2, cum2, caches), (tok2, parent)
+
+        (_, cum, _), (toks, parents) = lax.scan(
+            step, (tok0, cum, caches), jnp.arange(max_new - 1))
+        return tok0, toks, parents, cum
+
+    return run
+
+
+def _backtrack_beams(tok0, toks, parents, scores):
+    """Reassemble (b, W, max_new) sequences from per-step (token,
+    parent) records — walk each final beam's ancestry backwards."""
+    tok0, toks, parents, scores = (np.asarray(jax.device_get(a))
+                                   for a in (tok0, toks, parents,
+                                             scores))
+    steps, b, W = toks.shape
+    seqs = np.zeros((b, W, steps + 1), np.int32)
+    rows = np.arange(b)[:, None]
+    beam = np.tile(np.arange(W), (b, 1))  # final beams, in score order
+    for t in range(steps - 1, -1, -1):
+        seqs[:, :, t + 1] = toks[t][rows, beam]
+        beam = parents[t][rows, beam]
+    seqs[:, :, 0] = tok0[rows, beam]
+    return seqs, scores
+
+
+def _plan_cache(model, key, build):
+    """LRU-bounded compiled-plan cache: every distinct (prompt_len,
+    max_new, sampling/beam) tuple is its own XLA executable —
+    chat-style callers should pad prompts to a few bucket lengths, and
+    the bound keeps a long-lived server from accumulating executables
+    forever."""
+    cache = getattr(model, "_generate_fns", None)
+    if cache is None:
+        import collections
+        cache = model._generate_fns = collections.OrderedDict()
+    fn = cache.get(key)
+    if fn is None:
+        fn = cache[key] = build()
+        while len(cache) > 8:
+            cache.popitem(last=False)
+    else:
+        cache.move_to_end(key)
+    return fn
+
+
 def generate(model, prompt_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             seed: int = 0) -> np.ndarray:
+             seed: int = 0, num_beams: int = 1) -> np.ndarray:
     """Generate continuations for a batch of equal-length prompts.
 
     Args:
@@ -196,6 +289,9 @@ def generate(model, prompt_ids, max_new_tokens: int,
             temperature-scaled distribution.
         top_k: optional truncation to the k most likely tokens before
             sampling (ignored when greedy).
+        num_beams: > 1 runs deterministic beam search over that many
+            beams (temperature/top_k must be unset) and returns each
+            batch row's highest-log-prob sequence.
     Returns:
         (batch, prompt_len + max_new_tokens) int32 ids — prompt
         followed by the generated continuation.
@@ -218,25 +314,35 @@ def generate(model, prompt_ids, max_new_tokens: int,
     # is why there is no ring decode.  (Params under any strategy are
     # replicated or resharded by the jit on first call.)
     trainer = model.ensure_inference_ready()
+    if num_beams > 1:
+        if temperature != 0.0 or top_k is not None:
+            raise ValueError(
+                "beam search (num_beams > 1) is deterministic — "
+                "temperature/top_k do not apply")
+        if max_new_tokens < 1:
+            # the beam plan always scores at least the first token, so
+            # a 0-token request cannot keep the output-shape contract
+            raise ValueError("beam search needs max_new_tokens >= 1")
+        if num_beams > h["vocab_size"]:
+            raise ValueError(f"num_beams ({num_beams}) exceeds "
+                             f"vocab_size ({h['vocab_size']})")
+        fn = _plan_cache(model, ("beam", s_p, int(max_new_tokens),
+                                 int(num_beams)),
+                         lambda: build_beam_fn(h, s_p,
+                                               int(max_new_tokens),
+                                               int(num_beams)))
+        seqs, _ = _backtrack_beams(
+            *fn(trainer.state.params, jnp.asarray(prompt)))
+        # beams come out in descending cumulative log-prob order; all
+        # beams share one length, so raw log-prob IS the ranking
+        return np.concatenate([prompt.astype(np.int32), seqs[:, 0]],
+                              axis=1)
     key = (s_p, int(max_new_tokens), float(temperature),
            None if top_k is None else int(top_k))
-    # LRU-bounded compiled-plan cache: every distinct (prompt_len,
-    # max_new, sampling) tuple is its own XLA executable — chat-style
-    # callers should pad prompts to a few bucket lengths, and the bound
-    # keeps a long-lived server from accumulating executables forever
-    cache = getattr(model, "_generate_fns", None)
-    if cache is None:
-        import collections
-        cache = model._generate_fns = collections.OrderedDict()
-    fn = cache.get(key)
-    if fn is None:
-        fn = cache[key] = build_generate_fn(
-            h, s_p, int(max_new_tokens), float(temperature),
-            None if top_k is None else int(top_k))
-        while len(cache) > 8:
-            cache.popitem(last=False)
-    else:
-        cache.move_to_end(key)
+    fn = _plan_cache(model, key,
+                     lambda: build_generate_fn(
+                         h, s_p, int(max_new_tokens), float(temperature),
+                         None if top_k is None else int(top_k)))
     toks = fn(trainer.state.params, jnp.asarray(prompt),
               jax.random.PRNGKey(seed))
     return np.concatenate([prompt.astype(np.int32),

@@ -122,11 +122,13 @@ class TransformerLM(ZooModel):
         return Model(input=tokens, output=out, name="transformer_lm")
 
     def generate(self, prompt_ids, max_new_tokens: int,
-                 temperature: float = 0.0, top_k=None, seed: int = 0):
+                 temperature: float = 0.0, top_k=None, seed: int = 0,
+                 num_beams: int = 1):
         """Autoregressive continuation from a KV cache — greedy
-        (``temperature=0``) or temperature/top-k sampling; the whole
-        decode runs as ONE compiled scan.  See
-        :func:`analytics_zoo_tpu.models.generation.generate`."""
+        (``temperature=0``), temperature/top-k sampling, or beam search
+        (``num_beams > 1``); the whole decode runs as ONE compiled
+        scan.  See :func:`analytics_zoo_tpu.models.generation.generate`."""
         from .generation import generate
         return generate(self, prompt_ids, max_new_tokens,
-                        temperature=temperature, top_k=top_k, seed=seed)
+                        temperature=temperature, top_k=top_k, seed=seed,
+                        num_beams=num_beams)

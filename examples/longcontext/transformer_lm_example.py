@@ -71,6 +71,9 @@ def main():
     sampled = lm.generate(prompt, max_new_tokens=n_new, temperature=0.8,
                           top_k=4, seed=1)
     print("top-k sample:", np.asarray(sampled)[0, p_len:].tolist())
+
+    beam = lm.generate(prompt, max_new_tokens=n_new, num_beams=4)
+    print("beam-4 continuation:", np.asarray(beam)[0, p_len:].tolist())
     print("transformer lm example done")
 
 

@@ -129,3 +129,74 @@ def test_generate_validation():
         m.generate(np.zeros((1, 30), np.int32), max_new_tokens=10)
     with pytest.raises(ValueError, match="prompt_ids"):
         m.generate(np.zeros((8,), np.int32), max_new_tokens=2)
+    with pytest.raises(ValueError, match="deterministic"):
+        m.generate(np.zeros((1, 4), np.int32), max_new_tokens=2,
+                   num_beams=3, temperature=0.5)
+    with pytest.raises(ValueError, match="vocab_size"):
+        m.generate(np.zeros((1, 4), np.int32), max_new_tokens=2,
+                   num_beams=VOCAB + 1)
+    with pytest.raises(ValueError, match="max_new_tokens >= 1"):
+        m.generate(np.zeros((1, 4), np.int32), max_new_tokens=0,
+                   num_beams=2)
+
+
+def test_beam_width_one_equals_greedy():
+    """W=1 beam search degenerates to greedy decoding exactly (same
+    prefill, same cached steps, argmax == top-1)."""
+    m = _trained_lm()
+    prompt = np.random.default_rng(4).integers(0, VOCAB, (3, 8))
+    greedy = m.generate(prompt, max_new_tokens=5, temperature=0.0)
+    # num_beams=1 routes to the sampling path; drive the beam machinery
+    # itself at W=1 through the module function
+    from analytics_zoo_tpu.models.generation import (_backtrack_beams,
+                                                     build_beam_fn)
+    import jax.numpy as jnp
+    trainer = m.ensure_inference_ready()
+    fn = build_beam_fn(m.hyper, 8, 5, 1)
+    seqs, _ = _backtrack_beams(*fn(trainer.state.params,
+                                   jnp.asarray(prompt)))
+    np.testing.assert_array_equal(seqs[:, 0], greedy[:, 8:])
+
+
+def test_beam_search_finds_higher_likelihood_than_greedy():
+    """The canonical beam property: the returned sequence's TRUE
+    teacher-forced log-prob (scored by the full training forward) is >=
+    the greedy sequence's, and the internal cumulative score must equal
+    that independent score — pinning the beam bookkeeping (cache
+    gathers, parent tracking) to the training path."""
+    m = _trained_lm()
+    prompt = np.random.default_rng(6).integers(0, VOCAB, (4, 8))
+    max_new = 5
+
+    def scored(ids):
+        """Sum of per-step log-probs of ids[:, 8:] under the full
+        forward (teacher forcing)."""
+        pad = np.zeros((ids.shape[0], SEQ - ids.shape[1]), ids.dtype)
+        logp = m.predict(np.concatenate([ids, pad], 1),
+                         batch_size=ids.shape[0])
+        tot = np.zeros(ids.shape[0])
+        for t in range(max_new):
+            pos = 8 + t - 1  # logits at pos predict token at pos+1
+            tot += logp[np.arange(ids.shape[0]), pos, ids[:, 8 + t]]
+        return tot
+
+    greedy = m.generate(prompt, max_new_tokens=max_new, temperature=0.0)
+    beam = m.generate(prompt, max_new_tokens=max_new, num_beams=4)
+    assert beam.shape == greedy.shape
+    np.testing.assert_array_equal(beam[:, :8], prompt)
+    s_greedy, s_beam = scored(greedy), scored(beam)
+    assert (s_beam >= s_greedy - 1e-4).all(), (s_beam, s_greedy)
+
+    from analytics_zoo_tpu.models.generation import (_backtrack_beams,
+                                                     build_beam_fn)
+    import jax.numpy as jnp
+    trainer = m.ensure_inference_ready()
+    fn = build_beam_fn(m.hyper, 8, max_new, 4)
+    seqs, scores = _backtrack_beams(*fn(trainer.state.params,
+                                        jnp.asarray(prompt)))
+    np.testing.assert_array_equal(seqs[:, 0], beam[:, 8:])
+    full = np.concatenate([prompt.astype(np.int32), seqs[:, 0]], 1)
+    np.testing.assert_allclose(scores[:, 0], scored(full), rtol=1e-4,
+                               atol=1e-4)
+    # beams arrive best-first
+    assert (np.diff(scores, axis=1) <= 1e-6).all(), scores
