@@ -76,12 +76,16 @@ def _head_logits(params, hidden):
 
 def _embed_token(params, tok, pos):
     """Token + positional embedding for one decode step (tok: (rows,)
-    int ids, pos: scalar position)."""
+    int ids; pos: scalar shared position, or (rows,) per-row positions
+    for ragged prompts)."""
     emb = jnp.take(params["tok_embed"]["embeddings"],
                    tok.astype(jnp.int32), axis=0)
-    return emb + lax.dynamic_index_in_dim(
-        params["pos_embed"]["table"], pos, keepdims=False).astype(
-        emb.dtype)
+    table = params["pos_embed"]["table"]
+    if jnp.ndim(pos) == 0:
+        p = lax.dynamic_index_in_dim(table, pos, keepdims=False)
+    else:
+        p = jnp.take(table, pos, axis=0)  # (rows, d)
+    return emb + p.astype(emb.dtype)
 
 
 def _prefill(params, hyper, prompt, cache_len):
@@ -109,12 +113,25 @@ def _prefill(params, hyper, prompt, cache_len):
         x = x + _mlp(bp, f)
         pad = [(0, 0), (0, 0), (0, cache_len - s_p), (0, 0)]
         caches.append((jnp.pad(k, pad), jnp.pad(v, pad)))
-    return x[:, -1, :], caches
+    return x, caches
+
+
+def _cache_write(c, x_new, pos):
+    """Write one step's (b, h, d) k or v into the (b, h, t, d) cache at
+    ``pos`` — a shared scalar position, or (b,) per-row positions for
+    ragged prompts."""
+    xn = x_new[:, :, None, :]
+    if jnp.ndim(pos) == 0:
+        return lax.dynamic_update_slice_in_dim(c, xn, pos, axis=2)
+    return jax.vmap(
+        lambda cb, xb, pb: lax.dynamic_update_slice_in_dim(
+            cb, xb, pb, axis=1))(c, xn, pos)
 
 
 def _decode_step(params, hyper, caches, x_tok, pos):
     """One cached decode step: ``x_tok`` is the (b, d_model) embedding of
-    the current token (token + positional), ``pos`` its position.
+    the current token (token + positional), ``pos`` its position —
+    scalar, or (b,) per-row for ragged prompts.
     Returns (logits, updated caches)."""
     n_layers, moe_every = hyper["n_layers"], hyper["moe_every"]
     n_heads = hyper["n_heads"]
@@ -128,14 +145,13 @@ def _decode_step(params, hyper, caches, x_tok, pos):
         q = jnp.einsum("be,ehd->bhd", a, bp["attn"]["Wq"])
         k = jnp.einsum("be,ehd->bhd", a, bp["attn"]["Wk"])
         v = jnp.einsum("be,ehd->bhd", a, bp["attn"]["Wv"])
-        ck = lax.dynamic_update_slice_in_dim(ck, k[:, :, None, :], pos,
-                                             axis=2)
-        cv = lax.dynamic_update_slice_in_dim(cv, v[:, :, None, :], pos,
-                                             axis=2)
+        ck = _cache_write(ck, k, pos)
+        cv = _cache_write(cv, v, pos)
         d = q.shape[-1]
         scores = jnp.einsum("bhd,bhtd->bht", q, ck) / math.sqrt(d)
         t = ck.shape[2]
-        valid = jnp.arange(t)[None, None, :] <= pos
+        posv = jnp.broadcast_to(pos, (ck.shape[0],))
+        valid = jnp.arange(t)[None, None, :] <= posv[:, None, None]
         scores = jnp.where(valid, scores, -1e30)
         probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         o = jnp.einsum("bht,bhtd->bhd", probs.astype(cv.dtype), cv)
@@ -143,9 +159,7 @@ def _decode_step(params, hyper, caches, x_tok, pos):
         f = _layer_norm(bp["ln_m"], x)
         x = x + _mlp(bp, f)
         new_caches.append((ck, cv))
-    x = _layer_norm(params["ln_final"], x)
-    logits = x @ params["lm_head"]["W"] + params["lm_head"]["b"]
-    return logits, new_caches
+    return _head_logits(params, x), new_caches
 
 
 def _sample(logits, rng, temperature: float, top_k: Optional[int]):
@@ -162,16 +176,22 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int]):
 
 
 def build_generate_fn(hyper, s_p: int, max_new: int, temperature: float,
-                      top_k: Optional[int]):
+                      top_k: Optional[int], ragged: bool = False):
     """Compile one generation plan: (params, prompt, rng) -> (b, max_new)
-    sampled token ids.  Static: prompt length, step count, sampling
-    config.  The scan carries the caches, so the whole decode is one
-    XLA while-loop — no per-token host dispatch."""
+    sampled token ids — or, with ``ragged``, (params, prompt, lengths,
+    rng) where right-padded rows decode from their own (b,) prompt
+    lengths (per-row positions and cache slots).  Static: prompt width,
+    step count, sampling config.  The scan carries the caches, so the
+    whole decode is one XLA while-loop — no per-token host dispatch."""
     cache_len = s_p + max_new
 
-    @jax.jit
-    def run(params, prompt, rng):
-        last_hidden, caches = _prefill(params, hyper, prompt, cache_len)
+    def run(params, prompt, lengths, rng):
+        x, caches = _prefill(params, hyper, prompt, cache_len)
+        if lengths is None:
+            last_hidden = x[:, -1, :]
+        else:
+            # ragged (right-padded) prompts: each row's last REAL token
+            last_hidden = x[jnp.arange(x.shape[0]), lengths - 1]
         logits0 = _head_logits(params, last_hidden)
         rng0, rng_loop = jax.random.split(rng)
         tok0 = _sample(logits0, rng0, temperature, top_k)
@@ -179,7 +199,7 @@ def build_generate_fn(hyper, s_p: int, max_new: int, temperature: float,
         def step(carry, i):
             tok, caches, r = carry
             r, r_step = jax.random.split(r)
-            pos = s_p + i
+            pos = (s_p + i) if lengths is None else (lengths + i)
             emb = _embed_token(params, tok, pos)
             logits, caches = _decode_step(params, hyper, caches, emb, pos)
             nxt = _sample(logits, r_step, temperature, top_k)
@@ -189,7 +209,12 @@ def build_generate_fn(hyper, s_p: int, max_new: int, temperature: float,
             step, (tok0, caches, rng_loop), jnp.arange(max_new))
         return jnp.swapaxes(toks, 0, 1)  # (steps, b) -> (b, steps)
 
-    return run
+    if ragged:
+        return jax.jit(run)
+    # jit the 3-arg closure (not a bare lambda over a jitted fn) so the
+    # returned callable keeps .lower() — bench.py AOT-checks the plan
+    return jax.jit(lambda params, prompt, rng: run(params, prompt, None,
+                                                   rng))
 
 
 def build_beam_fn(hyper, s_p: int, max_new: int, beam_width: int):
@@ -204,8 +229,8 @@ def build_beam_fn(hyper, s_p: int, max_new: int, beam_width: int):
     @jax.jit
     def run(params, prompt):
         b = prompt.shape[0]
-        last_hidden, caches = _prefill(params, hyper, prompt, cache_len)
-        logits0 = _head_logits(params, last_hidden)
+        x, caches = _prefill(params, hyper, prompt, cache_len)
+        logits0 = _head_logits(params, x[:, -1, :])
         logp0 = jax.nn.log_softmax(logits0.astype(jnp.float32), axis=-1)
         cum, tok0 = lax.top_k(logp0, W)  # (b, W)
         # broadcast each cache row to its W beams (b-major: row b·W + w)
@@ -277,7 +302,8 @@ def _plan_cache(model, key, build):
 
 def generate(model, prompt_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             seed: int = 0, num_beams: int = 1) -> np.ndarray:
+             seed: int = 0, num_beams: int = 1,
+             prompt_lengths=None) -> np.ndarray:
     """Generate continuations for a batch of equal-length prompts.
 
     Args:
@@ -292,9 +318,16 @@ def generate(model, prompt_ids, max_new_tokens: int,
         num_beams: > 1 runs deterministic beam search over that many
             beams (temperature/top_k must be unset) and returns each
             batch row's highest-log-prob sequence.
+        prompt_lengths: optional (batch,) true lengths of RIGHT-padded
+            ragged prompts.  Each row decodes from its own last real
+            token with per-row positions; its continuation lands at
+            ``[lengths[b], lengths[b] + max_new_tokens)`` in the
+            returned array (positions past that keep value 0).  Not
+            combinable with beam search.
     Returns:
         (batch, prompt_len + max_new_tokens) int32 ids — prompt
-        followed by the generated continuation.
+        followed by the generated continuation (right-aligned per row
+        when ``prompt_lengths`` is given, see above).
     """
     prompt = np.asarray(prompt_ids)
     if prompt.ndim != 2:
@@ -314,6 +347,23 @@ def generate(model, prompt_ids, max_new_tokens: int,
     # is why there is no ring decode.  (Params under any strategy are
     # replicated or resharded by the jit on first call.)
     trainer = model.ensure_inference_ready()
+    if prompt_lengths is not None:
+        lengths = np.asarray(prompt_lengths)
+        if lengths.shape != (prompt.shape[0],):
+            raise ValueError(
+                f"prompt_lengths must be ({prompt.shape[0]},), got "
+                f"shape {lengths.shape}")
+        if (lengths < 1).any() or (lengths > s_p).any():
+            raise ValueError(
+                f"prompt_lengths must lie in [1, {s_p}]")
+        if num_beams > 1:
+            raise ValueError(
+                "prompt_lengths is not supported with beam search — "
+                "pad prompts to equal length for num_beams > 1")
+    if num_beams <= 1 and int(max_new_tokens) == 0:
+        # nothing to decode — same (b, s_p) result on both sampling
+        # paths without building a plan (beam keeps its >= 1 raise)
+        return prompt.astype(np.int32)
     if num_beams > 1:
         if temperature != 0.0 or top_k is not None:
             raise ValueError(
@@ -337,12 +387,29 @@ def generate(model, prompt_ids, max_new_tokens: int,
         # beams share one length, so raw log-prob IS the ranking
         return np.concatenate([prompt.astype(np.int32), seqs[:, 0]],
                               axis=1)
+    ragged = prompt_lengths is not None
     key = (s_p, int(max_new_tokens), float(temperature),
-           None if top_k is None else int(top_k))
+           None if top_k is None else int(top_k), ragged)
     fn = _plan_cache(model, key,
                      lambda: build_generate_fn(
                          h, s_p, int(max_new_tokens), float(temperature),
-                         None if top_k is None else int(top_k)))
+                         None if top_k is None else int(top_k),
+                         ragged=ragged))
+    if ragged:
+        toks = fn(trainer.state.params, jnp.asarray(prompt),
+                  jnp.asarray(lengths, jnp.int32),
+                  jax.random.PRNGKey(seed))
+        toks = np.asarray(jax.device_get(toks), np.int32)
+        out = np.zeros((prompt.shape[0], s_p + int(max_new_tokens)),
+                       np.int32)
+        out[:, :s_p] = prompt
+        rows = np.arange(prompt.shape[0])[:, None]
+        cols = lengths[:, None] + np.arange(int(max_new_tokens))[None]
+        out[rows, cols] = toks
+        # anything past each row's continuation is not real content
+        mask = np.arange(out.shape[1])[None] >= cols[:, -1:] + 1
+        out[mask] = 0
+        return out
     toks = fn(trainer.state.params, jnp.asarray(prompt),
               jax.random.PRNGKey(seed))
     return np.concatenate([prompt.astype(np.int32),

@@ -25,6 +25,7 @@ last resort so a parsed value always exists.  An XLA compilation cache
 under .jax_cache makes retries cheap.
 """
 
+import glob
 import json
 import os
 import subprocess
@@ -996,13 +997,24 @@ def main():
         # the r4 additions) — a complete CPU artifact, not a truncated
         # one, is what makes the outage legible (r3 precedent)
         plan = [("tpu", 900, 10), ("cpu", 2100, 0)]
-    # fresh run => fresh measurements: drop stale partials so the
-    # cross-ATTEMPT resume below never picks up a previous run's numbers
+    # fresh run => fresh measurements: move stale partials aside so the
+    # cross-ATTEMPT resume below never picks up a previous run's
+    # numbers.  ARCHIVE (timestamped, pruned to the newest 8) rather
+    # than delete — a TPU window's evidence must survive any number of
+    # later launches in dead windows (this round lost the 03:17 UTC
+    # window's raw partial exactly this way).
     for pf in ("tpu", "cpu"):
+        path = os.path.join(REPO, f"BENCH_PARTIAL_{pf}.json")
         try:
-            os.remove(os.path.join(REPO, f"BENCH_PARTIAL_{pf}.json"))
+            os.replace(path, f"{path}.{int(time.time())}.prev")
         except OSError:
             pass
+        old = sorted(glob.glob(f"{path}.*.prev"))
+        for stale in old[:-8]:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
     last_fail = None
     for i, (platform, timeout, backoff) in enumerate(plan):
         _log(f"attempt {i + 1}/{len(plan)}: platform={platform} "

@@ -138,6 +138,63 @@ def test_generate_validation():
     with pytest.raises(ValueError, match="max_new_tokens >= 1"):
         m.generate(np.zeros((1, 4), np.int32), max_new_tokens=0,
                    num_beams=2)
+    # max_new_tokens=0 returns the prompt unchanged on both sampling
+    # paths (no plan built)
+    p0 = np.asarray([[3, 1, 4, 1], [5, 9, 2, 6]], np.int32)
+    np.testing.assert_array_equal(m.generate(p0, max_new_tokens=0), p0)
+    np.testing.assert_array_equal(
+        m.generate(p0, max_new_tokens=0,
+                   prompt_lengths=np.array([4, 2])), p0)
+    # the compiled plan object keeps .lower() — bench.py AOT-checks it
+    from analytics_zoo_tpu.models.generation import build_generate_fn
+    assert hasattr(build_generate_fn(m.hyper, 4, 2, 0.0, None), "lower")
+
+
+def test_ragged_prompts_match_per_row_generation():
+    """prompt_lengths: each right-padded row must decode EXACTLY as it
+    would alone, unpadded — per-row positions, per-row cache slots, and
+    the per-row last-real-token prefill handoff all pinned by the
+    strongest oracle there is (the same model, one row at a time)."""
+    m = _trained_lm()
+    rng = np.random.default_rng(7)
+    lengths = np.array([8, 5, 3])
+    s_p, max_new = 8, 5
+    prompt = np.zeros((3, s_p), np.int64)
+    rows = []
+    for i, L in enumerate(lengths):
+        rows.append(rng.integers(0, VOCAB, L))
+        prompt[i, :L] = rows[i]
+    out = m.generate(prompt, max_new_tokens=max_new, temperature=0.0,
+                     prompt_lengths=lengths)
+    assert out.shape == (3, s_p + max_new)
+    for i, L in enumerate(lengths):
+        solo = m.generate(rows[i][None, :], max_new_tokens=max_new,
+                          temperature=0.0)
+        np.testing.assert_array_equal(out[i, :L], rows[i])
+        np.testing.assert_array_equal(
+            out[i, L:L + max_new], solo[0, L:],
+            err_msg=f"row {i} (length {L}) diverged from its solo run")
+        assert (out[i, L + max_new:] == 0).all()
+    # full-length prompt_lengths degenerate to the uniform path
+    uniform = m.generate(prompt, max_new_tokens=max_new,
+                         temperature=0.0)
+    ragged_full = m.generate(prompt, max_new_tokens=max_new,
+                             temperature=0.0,
+                             prompt_lengths=np.full(3, s_p))
+    np.testing.assert_array_equal(ragged_full, uniform)
+
+
+def test_ragged_prompt_validation():
+    m = _trained_lm()
+    p = np.zeros((2, 6), np.int32)
+    with pytest.raises(ValueError, match="prompt_lengths must be"):
+        m.generate(p, max_new_tokens=2, prompt_lengths=np.array([6]))
+    with pytest.raises(ValueError, match=r"\[1, 6\]"):
+        m.generate(p, max_new_tokens=2,
+                   prompt_lengths=np.array([6, 7]))
+    with pytest.raises(ValueError, match="not supported with beam"):
+        m.generate(p, max_new_tokens=2, num_beams=2,
+                   prompt_lengths=np.array([6, 5]))
 
 
 def test_beam_width_one_equals_greedy():
