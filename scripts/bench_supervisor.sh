@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-5 window catcher: let an in-flight bench.py finish its TPU
+# attempt, but skip its CPU fallback (a CPU artifact already exists from
+# r4; host CPU time is better spent probing for the next live window),
+# then keep tpu_watch.sh armed until the deadline.
+PARENT=${1:?usage: bench_supervisor.sh <bench_parent_pid>}
+LOG=${2:-/root/repo/bench_r5.log}
+while kill -0 "$PARENT" 2>/dev/null; do
+  if grep -q "platform=cpu" "$LOG" 2>/dev/null; then
+    echo "[supervisor] bench moved to CPU fallback — stopping it"
+    pkill -P "$PARENT" 2>/dev/null
+    kill "$PARENT" 2>/dev/null
+    break
+  fi
+  sleep 20
+done
+echo "[supervisor] arming tpu_watch"
+PERIOD=${PERIOD:-300} exec /root/repo/scripts/tpu_watch.sh
