@@ -13,7 +13,28 @@ import logging
 import os
 from typing import List
 
+import numpy as np
+
 log = logging.getLogger("analytics_zoo_tpu")
+
+
+def pad_leading(batch, pad: int):
+    """Zero-pad the leading (batch) axis of every array in ``batch`` (an
+    array or tuple/list of arrays) by ``pad`` rows, PRESERVING dtype —
+    integer embedding/gather ids must stay integer.  The single padding
+    helper shared by the trainer's fixed-shape batch loops and the
+    serving bucket cache."""
+    if pad == 0:
+        return batch
+
+    def one(a):
+        a = np.asarray(a)
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
+
+    if isinstance(batch, (tuple, list)):
+        return tuple(one(a) for a in batch)
+    return one(batch)
 
 
 def list_local_files(path: str) -> List[str]:

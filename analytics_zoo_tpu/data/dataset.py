@@ -461,19 +461,14 @@ def check_batch_divisibility(batch_size: int, dp: int, n_processes: int = 1):
 def prefetch_iterator(iterator: Iterator, put_fn: Callable, depth: int = 2):
     """Keep ``depth`` device-put batches in flight ahead of the consumer.
 
-    ``jax.device_put`` is asynchronous, so enqueueing the next batches while
-    the current step computes overlaps host→device transfer with the device
-    step — the role the reference's Spark-partition prefetch played.  This
-    replaces the synchronous put-then-step pattern (one of the "2 Spark jobs
-    per step" overheads the rebuild removes, wp-bigdl.md:113-160)."""
-    import collections
-    q = collections.deque()
-    for item in iterator:
-        q.append(put_fn(item))
-        if len(q) > depth:
-            yield q.popleft()
-    while q:
-        yield q.popleft()
+    ``jax.device_put`` is asynchronous, but the HOST work feeding it
+    (decode, shuffle-gather, ``np.stack``, padding) is not — so this now
+    delegates to ``common.prefetch``: ``put_fn`` runs on a background
+    thread, overlapping batch *k+1*'s host materialization AND transfer
+    with batch *k*'s device compute (the role the reference's
+    Spark-partition prefetch played, wp-bigdl.md:113-160)."""
+    from ..common.prefetch import prefetch
+    return prefetch(iterator, transform=put_fn, depth=depth)
 
 
 def shard_batch(batch, sharding):

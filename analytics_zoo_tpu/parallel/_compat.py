@@ -1,0 +1,43 @@
+"""Version compat shims for the jax API surface the parallel layer uses.
+
+Two drifts covered for ``shard_map``:
+
+* its home: promoted out of ``jax.experimental`` late in the 0.4.x line —
+  on the pinned 0.4.37 it still lives at
+  ``jax.experimental.shard_map.shard_map``;
+* its replication-check kwarg: renamed ``check_rep`` → ``check_vma``
+  alongside the promotion.  Callers here use the NEW name; the shim
+  translates for older jax.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.4.44 exports it at top level
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # pinned 0.4.37 path
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+
+if "check_vma" in _PARAMS:
+    shard_map = _shard_map
+else:
+    def shard_map(*args, check_vma=None, **kwargs):
+        if check_vma is not None and "check_rep" not in kwargs:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(*args, **kwargs)
+
+from jax import lax as _lax
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` appeared after 0.4.37; ``psum`` of the literal 1
+    is the portable spelling (constant-folded to the mapped axis size)."""
+    if hasattr(_lax, "axis_size"):
+        return _lax.axis_size(axis_name)
+    return _lax.psum(1, axis_name)
+
+
+__all__ = ["shard_map", "axis_size"]

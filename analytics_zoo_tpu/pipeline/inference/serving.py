@@ -1,0 +1,532 @@
+"""Serving fast path: shape-bucketed executables + request coalescing.
+
+Two measured walls motivate this module (PERF_NOTES):
+
+* **Compile-per-shape.** A jitted forward re-traces for every distinct
+  batch size, so a live request stream with ragged batch sizes compiles
+  continuously.  ``BucketedExecutableCache`` pads every batch up to a
+  small geometric ladder of batch sizes (1, 2, 4, … max_batch by
+  default) so the whole stream is served by a handful of pre-compilable
+  executables, with per-bucket hit/miss/compile-time counters and an
+  AOT ``warmup``.
+* **Per-dispatch floor.** A dispatched computation has a ~4-8 ms floor
+  (PERF_NOTES §"Per-dispatch floor"), so one device call per request
+  caps throughput regardless of model size.  ``RequestCoalescer`` packs
+  concurrent ``predict()`` callers into ONE padded device batch per
+  dispatch and fans the rows back out — amortizing the floor across
+  every rider.
+
+Padding safety: rows are independent under inference-mode forward
+passes (BatchNorm uses running stats, softmax is row-wise), so padded
+filler rows cannot perturb real rows and un-padded results are
+bit-identical to a solo run.  Computations with BATCH-GLOBAL terms —
+int8 dynamic activation scales — are NOT row-independent; callers must
+keep those on the exact-shape path (``InferenceModel`` does).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+
+from ...common.utils import pad_leading as _pad_rows
+
+
+def bucket_ladder(max_batch: int, growth: float = 2.0,
+                  min_batch: int = 1) -> Tuple[int, ...]:
+    """The geometric ladder of padded batch sizes: ``min_batch`` scaled
+    by ``growth`` until ``max_batch`` (always included)."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if growth <= 1.0:
+        raise ValueError(f"bucket growth must be > 1, got {growth}")
+    out: List[int] = []
+    b = float(max(1, min_batch))
+    while int(b) < max_batch:
+        if not out or int(b) != out[-1]:
+            out.append(int(b))
+        b *= growth
+    out.append(int(max_batch))
+    return tuple(out)
+
+
+def _rows(batched) -> int:
+    first = batched[0] if isinstance(batched, (tuple, list)) else batched
+    return int(np.asarray(first).shape[0])
+
+
+def _slice_rows(tree, start: int, stop: int):
+    return jax.tree_util.tree_map(lambda a: a[start:stop], tree)
+
+
+def _concat_trees(trees: Sequence):
+    """Concatenate result trees (arrays or tuples of arrays) row-wise."""
+    if len(trees) == 1:
+        return trees[0]
+    first = trees[0]
+    if isinstance(first, (tuple, list)):
+        return type(first)(
+            np.concatenate([t[i] for t in trees])
+            for i in range(len(first)))
+    return np.concatenate(trees)
+
+
+def batch_signature(batched) -> Tuple:
+    """Everything but the batch row count: per-input trailing shape +
+    dtype.  Two batches coalesce / share a bucket executable iff their
+    signatures match."""
+    def one(a):
+        a = np.asarray(a)
+        return (tuple(a.shape[1:]), str(a.dtype))
+
+    if isinstance(batched, (tuple, list)):
+        return tuple(one(a) for a in batched)
+    return (one(batched),)
+
+
+class BucketStats:
+    """Per-bucket serving counters (thread-safe snapshots via dict copy)."""
+
+    def __init__(self):
+        self.hits: Dict[int, int] = {}
+        self.misses: Dict[int, int] = {}
+        self.compile_time_s: Dict[int, float] = {}
+
+    def snapshot(self) -> Dict[str, Dict[int, Any]]:
+        return {"hits": dict(self.hits), "misses": dict(self.misses),
+                "compile_time_s": dict(self.compile_time_s)}
+
+
+class BucketedExecutableCache:
+    """Pad batches to a bucket ladder so a ragged request stream hits a
+    handful of compiled executables.
+
+    ``fn`` is the (jitted underneath) forward over one host batch; the
+    jit's own shape cache holds the executables — this layer guarantees
+    only ladder shapes ever reach it, tracks hit/miss/compile-time per
+    bucket, and un-pads results.  Batches larger than the top bucket are
+    served in top-bucket chunks (the tail padded), so arbitrarily large
+    inputs still hit only ladder shapes.
+    """
+
+    def __init__(self, fn: Callable, max_batch: int = 32,
+                 buckets: Optional[Sequence[int]] = None,
+                 growth: float = 2.0):
+        self._fn = fn
+        self.buckets = (tuple(sorted(set(int(b) for b in buckets)))
+                        if buckets else bucket_ladder(max_batch, growth))
+        if self.buckets[0] < 1:
+            raise ValueError(f"buckets must be >= 1, got {self.buckets}")
+        self.max_batch = self.buckets[-1]
+        self.stats = BucketStats()
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket >= n (top bucket for oversized n)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_batch
+
+    def _dispatch(self, batched, bucket: int):
+        """Run one exactly-bucket-sized padded batch, with counters."""
+        sig = (bucket, batch_signature(batched))
+        with self._lock:
+            fresh = sig not in self._seen
+            if fresh:
+                self._seen.add(sig)
+                self.stats.misses[bucket] = \
+                    self.stats.misses.get(bucket, 0) + 1
+            else:
+                self.stats.hits[bucket] = self.stats.hits.get(bucket, 0) + 1
+        if fresh:
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(self._fn(batched))
+            with self._lock:
+                self.stats.compile_time_s[bucket] = \
+                    self.stats.compile_time_s.get(bucket, 0.0) \
+                    + (time.perf_counter() - t0)
+            return out
+        return self._fn(batched)
+
+    def run(self, batched, sem: Optional[threading.Semaphore] = None):
+        """Serve one host batch of any row count; returns HOST numpy
+        results with padding rows removed.  ``sem`` (the owner's
+        device-concurrency bound) is held around the DISPATCH only —
+        the blocking host fetch happens outside it, so concurrent
+        callers' dispatches overlap each other's result transfers."""
+        guard = sem if sem is not None else contextlib.nullcontext()
+        n = _rows(batched)
+        if n == 0:
+            # run the smallest bucket and keep zero rows — the output
+            # structure/shape contract stays intact for empty inputs
+            with guard:
+                out = self._dispatch(_pad_rows(batched, self.buckets[0]),
+                                     self.buckets[0])
+            return fetch_rows(out, 0)
+        outs = []
+        start = 0
+        while start < n:
+            take = min(self.max_batch, n - start)
+            chunk = _slice_rows(batched, start, start + take) \
+                if (start or take < n) else batched
+            bucket = self.bucket_for(take)
+            padded = _pad_rows(chunk, bucket - take)
+            with guard:
+                out = self._dispatch(padded, bucket)
+            outs.append(fetch_rows(out, take))
+            start += take
+        return _concat_trees(outs)
+
+    def dispatch_padded(self, batched):
+        """Async single dispatch: pad to the bucket and return the
+        DEVICE result tree without fetching.  jax dispatch is
+        asynchronous, so the caller can overlap host work (gathering
+        the next batch) with this compute and fetch later via
+        ``fetch_rows``.  One bucket only — rows must fit ``max_batch``."""
+        n = _rows(batched)
+        if n > self.max_batch:
+            raise ValueError(
+                f"dispatch_padded: {n} rows exceed the top bucket "
+                f"{self.max_batch}; use run() for chunked serving")
+        bucket = self.bucket_for(max(n, 1))
+        return self._dispatch(_pad_rows(batched, bucket - n), bucket)
+
+    def warmup(self, sample_shapes, dtypes=None,
+               buckets: Optional[Sequence[int]] = None) -> float:
+        """AOT-compile the ladder for one input signature.
+
+        ``sample_shapes``: per-sample shape (no batch axis) for a
+        single-input model, or a list of them for multi-input;
+        ``dtypes`` matches element-wise (default float32).  Returns the
+        total compile wall seconds spent."""
+        multi = (sample_shapes and
+                 isinstance(sample_shapes[0], (tuple, list)))
+        shapes = list(sample_shapes) if multi else [sample_shapes]
+        if dtypes is None:
+            dts = [np.float32] * len(shapes)
+        elif isinstance(dtypes, (tuple, list)):
+            dts = list(dtypes)
+        else:
+            dts = [dtypes] * len(shapes)
+        t0 = time.perf_counter()
+        for b in (buckets or self.buckets):
+            arrs = tuple(np.zeros((b,) + tuple(s), dt)
+                         for s, dt in zip(shapes, dts))
+            self._dispatch(arrs if multi else arrs[0], b)
+        return time.perf_counter() - t0
+
+
+def fetch_rows(device_tree, n: int):
+    """Block on a ``dispatch_padded`` result and strip the padding."""
+    host = jax.tree_util.tree_map(
+        lambda a: np.asarray(jax.device_get(a)), device_tree)
+    return _slice_rows(host, 0, n)
+
+
+class _Request:
+    __slots__ = ("batched", "n", "sig", "future")
+
+    def __init__(self, batched, n, sig):
+        self.batched = batched
+        self.n = n
+        self.sig = sig
+        self.future: Future = Future()
+
+
+_SHUTDOWN = object()
+
+
+class CoalescerClosedError(RuntimeError):
+    """The dispatcher is gone — this request was (or would be) never
+    served.  Distinct type so callers can fall back to the solo path
+    without masking genuine model-execution errors (XlaRuntimeError is
+    a RuntimeError subclass)."""
+
+
+class RequestCoalescer:
+    """Pack concurrent predict() calls into one device dispatch, with
+    the NEXT batch gathered while the current one computes.
+
+    Callers ``submit()`` into a bounded queue; a single dispatcher
+    thread takes the head request, gathers same-signature riders until
+    ``max_batch`` rows are packed, ``max_wait_ms`` elapses, or the
+    queue momentarily drains, concatenates them into one padded batch,
+    and dispatches it through the bucketed ``cache`` WITHOUT fetching —
+    jax dispatch is asynchronous, so the dispatcher goes straight back
+    to gathering the next group while the device computes, then fetches
+    and fans rows back onto each caller's Future (one-deep pipeline:
+    the serving-side analog of the data path's double-buffered
+    prefetch).  A signature mismatch ends a group — the odd request
+    leads the next one, so mixed streams stay correct, just un-packed
+    across shapes.
+
+    ``semaphore`` (the owner's ``supported_concurrent_num`` bound) is
+    held from dispatch to fetch so coalesced work counts against the
+    same device-concurrency budget as solo calls.
+    """
+
+    def __init__(self, cache: BucketedExecutableCache,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: float = 2.0,
+                 semaphore: Optional[threading.Semaphore] = None,
+                 pipeline_depth: int = 2,
+                 queue_size: int = 1024):
+        self._cache = cache
+        self.max_batch = int(max_batch or cache.max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self._sem = semaphore
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._carry: Optional[_Request] = None
+        self.dispatches = 0
+        self.coalesced_requests = 0
+        # live-request accounting: _outstanding counts submitted-but-
+        # unresolved requests; _inflight_n the subset already dispatched.
+        # Their difference is every rider that could still arrive — once
+        # a group holds them all, waiting any longer is pure latency.
+        self._outstanding = 0
+        self._out_lock = threading.Lock()
+        self._inflight_n = 0
+        self._closed = False
+        # makes (closed-check + enqueue) atomic against close()'s
+        # (set-closed + sentinel + drain): a submit can never slip into
+        # the queue after the drain.  Separate from _out_lock — a put
+        # blocking on a full queue must not deadlock the dispatcher's
+        # _done() accounting.
+        self._submit_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._loop, name="zoo-serving-dispatch", daemon=True)
+        self._thread.start()
+
+    @property
+    def closed(self) -> bool:
+        """True once close() ran or the dispatcher died — submits would
+        never be served."""
+        return self._closed or not self._thread.is_alive()
+
+    def submit(self, batched) -> Future:
+        n = _rows(batched)
+        if n > self.max_batch:
+            raise ValueError(
+                f"coalesced request of {n} rows exceeds max_batch "
+                f"{self.max_batch} — send it through the solo path")
+        req = _Request(batched, n, batch_signature(batched))
+        with self._submit_lock:
+            if self.closed:
+                raise CoalescerClosedError(
+                    "RequestCoalescer is closed — no dispatcher is "
+                    "serving this queue")
+            with self._out_lock:
+                self._outstanding += 1
+            self._q.put(req)
+        return req.future
+
+    def _done(self, k: int):
+        with self._out_lock:
+            self._outstanding -= k
+
+    def close(self, timeout: float = 5.0):
+        """Stop the dispatcher; fail any request racing the shutdown
+        (idempotent)."""
+        with self._submit_lock:
+            already = self._closed
+            self._closed = True
+            if not already and self._thread.is_alive():
+                self._q.put(_SHUTDOWN)
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            # the dispatcher is wedged mid-group (e.g. a long compile) —
+            # it still owns _carry and the queue, so leave both alone;
+            # it will drain to the sentinel and exit on its own
+            return
+        leftovers, self._carry = (
+            [self._carry] if self._carry is not None else []), None
+        try:
+            while True:
+                r = self._q.get_nowait()
+                if r is not _SHUTDOWN:
+                    leftovers.append(r)
+        except queue.Empty:
+            pass
+        for r in leftovers:
+            if not r.future.done():
+                r.future.set_exception(
+                    CoalescerClosedError("RequestCoalescer closed"))
+
+    # ---- dispatcher ----
+    def _gather(self, block: bool,
+                pipeline_busy: bool = False) -> Tuple[List[_Request], bool]:
+        """One group: head + same-signature riders until the batch is
+        full, the wait budget lapses, or the queue momentarily drains.
+        The drain condition is the important one: callers are blocked
+        on their futures, so once the queue is empty, holding a partial
+        batch for the rest of ``max_wait_ms`` cannot attract closed-loop
+        riders — it only adds their wait to every row.  A short grace
+        (max_wait/8) still absorbs staggered arrivals.  Returns
+        (group, shutdown_seen); with ``block`` False the head wait is
+        bounded by the grace too (a dispatch is in flight — the
+        dispatcher must come back to fetch it promptly)."""
+        grace = max(min(self.max_wait_ms / 8.0, 0.5), 0.05) / 1000.0
+        head = self._carry
+        self._carry = None
+        if head is None:
+            try:
+                head = (self._q.get() if block
+                        else self._q.get(timeout=grace))
+            except queue.Empty:
+                return [], False
+            if head is _SHUTDOWN:
+                return [], True
+        group, count, rows = [head], 1, head.n
+        deadline = time.perf_counter() + self.max_wait_ms / 1000.0
+        while rows < self.max_batch:
+            # every live request not yet dispatched is either in this
+            # group or could still ride it; once the group holds them
+            # all, no grace wait can attract another — dispatch now.
+            # Only when the device is idle, though: with a dispatch in
+            # flight there is no urgency, and the about-to-resolve
+            # riders will want seats on THIS group
+            if not pipeline_busy \
+                    and count >= self._outstanding - self._inflight_n:
+                break
+            remaining = deadline - time.perf_counter()
+            try:
+                nxt = (self._q.get_nowait() if remaining <= 0
+                       else self._q.get(timeout=min(remaining, grace)))
+            except queue.Empty:
+                break
+            if nxt is _SHUTDOWN:
+                return group, True
+            if nxt.sig != head.sig or rows + nxt.n > self.max_batch:
+                self._carry = nxt
+                break
+            group.append(nxt)
+            count += 1
+            rows += nxt.n
+        return group, False
+
+    def _acquire_slot(self, inflight):
+        """Take one device-concurrency slot without deadlocking: the
+        dispatcher itself may hold every slot via unfetched dispatches,
+        so on contention it resolves its oldest in-flight group (which
+        releases a slot) before blocking."""
+        if self._sem is None:
+            return
+        while not self._sem.acquire(blocking=False):
+            if inflight:
+                self._resolve(*inflight.popleft())
+            else:
+                self._sem.acquire()  # held by solo callers — just wait
+                return
+
+    def _dispatch_group(self, group: List[_Request], inflight):
+        """Concat + async dispatch; returns (group, rows, device_out)
+        or None when the dispatch itself failed."""
+        try:
+            batched = _concat_trees([r.batched for r in group]) \
+                if len(group) > 1 else group[0].batched
+            n = sum(r.n for r in group)
+            self._acquire_slot(inflight)
+            try:
+                dev = self._cache.dispatch_padded(batched)
+            except BaseException:
+                if self._sem is not None:
+                    self._sem.release()
+                raise
+            self.dispatches += 1
+            self.coalesced_requests += len(group)
+            self._inflight_n += len(group)
+            return group, n, dev
+        except BaseException as e:
+            self._done(len(group))
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return None
+
+    def _resolve(self, group: List[_Request], n: int, dev):
+        """Fetch a dispatched group's device result and fan rows out."""
+        try:
+            out = fetch_rows(dev, n)
+            err = None
+        except BaseException as e:
+            out, err = None, e
+        # retire the group from the live count BEFORE waking callers, so
+        # their resubmissions aren't double-counted against the next
+        # gather's early-dispatch check
+        self._inflight_n -= len(group)
+        self._done(len(group))
+        try:
+            if err is None:
+                off = 0
+                for r in group:
+                    if not r.future.done():  # close() may have raced us
+                        r.future.set_result(
+                            _slice_rows(out, off, off + r.n))
+                    off += r.n
+            else:
+                for r in group:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+        finally:
+            if self._sem is not None:
+                self._sem.release()
+
+    def _loop(self):
+        try:
+            self._loop_inner()
+        except BaseException as e:  # crash net: never strand a caller
+            carry, self._carry = self._carry, None
+            if carry is not None and not carry.future.done():
+                carry.future.set_exception(e)
+            try:
+                while True:
+                    r = self._q.get_nowait()
+                    if r is not _SHUTDOWN and not r.future.done():
+                        r.future.set_exception(e)
+            except queue.Empty:
+                pass
+            raise
+
+    def _loop_inner(self):
+        import collections
+        inflight: "collections.deque" = collections.deque()
+        shutdown = False
+        while True:
+            group: List[_Request] = []
+            if not shutdown:
+                if inflight and self._carry is None and self._q.empty():
+                    # nothing to gather and dispatches in flight: every
+                    # closed-loop caller is blocked on a future — fetch
+                    # and fan the oldest out NOW so they can resubmit,
+                    # instead of grace-waiting on a queue that cannot fill
+                    self._resolve(*inflight.popleft())
+                # gathering overlaps the in-flight groups' device compute
+                group, shutdown = self._gather(
+                    block=not inflight, pipeline_busy=bool(inflight))
+            elif self._carry is not None:
+                # a mismatched rider was pulled before the shutdown
+                # sentinel — it still must be served
+                group, _ = self._gather(block=False)
+            if group:
+                disp = self._dispatch_group(group, inflight)
+                if disp is not None:
+                    inflight.append(disp)
+            # fetch the oldest group when the pipeline is full, or when
+            # there was nothing to gather (its callers are waiting and
+            # no new work arrived to overlap with)
+            if inflight and (not group
+                             or len(inflight) >= self.pipeline_depth):
+                self._resolve(*inflight.popleft())
+            if shutdown and not inflight and self._carry is None:
+                return

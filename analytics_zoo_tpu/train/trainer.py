@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ..common.utils import pad_leading
 from ..data.dataset import (Dataset, check_batch_divisibility,
                             prefetch_iterator, shard_batch)
 from ..parallel import distributed as dist_lib
@@ -40,18 +41,9 @@ from .checkpoint import wait_pending as checkpoint_lib_wait_pending
 from .summary import TrainSummary, ValidationSummary
 
 
-def _pad_tail(batch, pad: int):
-    """Zero-pad the leading axis of every array in a batch (array or
-    tuple/list of arrays) by ``pad`` rows, keeping one compiled shape for
-    the trailing partial batch of evaluate/predict."""
-
-    def _pad(a):
-        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-        return np.pad(a, widths)
-
-    if isinstance(batch, (tuple, list)):
-        return tuple(_pad(a) for a in batch)
-    return _pad(batch)
+# zero-pad the trailing partial batch of evaluate/predict to keep one
+# compiled shape (shared helper: common/utils.py)
+_pad_tail = pad_leading
 
 
 class TrainState:
@@ -351,7 +343,12 @@ class Trainer:
             y_pred, _ = model.apply(params, model_state, x, training=False)
             return y_pred
 
-        return jax.jit(predict_step)
+        # the batch buffer is freshly device_put per step by the prefetch
+        # thread and never read after the step — donating it lets XLA
+        # write activations into it instead of allocating.  CPU doesn't
+        # implement input donation (it would warn per call), so gate it.
+        donate = (2,) if jax.default_backend() in ("tpu", "gpu") else ()
+        return jax.jit(predict_step, donate_argnums=donate)
 
     # ------------------------------------------------------------------
     _warned_replicated = False
@@ -486,8 +483,9 @@ class Trainer:
                 epoch_losses = []
                 batch_it = dataset.batches(per_host_bs, shuffle=shuffle,
                                            seed=self.seed, epoch=st.epoch)
-                for bx, by in prefetch_iterator(
-                        batch_it, lambda b: self._put_batch(*b)):
+                dev_it = prefetch_iterator(batch_it,
+                                           lambda b: self._put_batch(*b))
+                for bx, by in dev_it:
                     step_rng = jax.random.fold_in(st.rng, st.step)
                     st.params, st.model_state, st.opt_state, loss = \
                         self._train_step(st.params, st.model_state,
@@ -512,6 +510,9 @@ class Trainer:
                         # (e.g. MinLoss — the per-epoch record carries no loss)
                         stop = True
                         break
+                # stop the worker deterministically — an iteration-level
+                # end trigger breaks out with batches still buffered
+                dev_it.close()
                 st.epoch += 1
                 # one bulk host transfer for the whole epoch's scalars
                 losses_host = ([float(v) for v in
@@ -689,6 +690,8 @@ class Trainer:
             ds = Dataset.from_ndarray(dataset_or_x)
         outs = []
         n = ds.size
+        if n == 0:  # size None (unknown stream length) passes through
+            raise ValueError("predict called with an empty dataset")
         nproc = dist_lib.process_count()
         per_host_bs = max(batch_size // nproc, 1)
         if nproc > 1:
@@ -700,15 +703,23 @@ class Trainer:
                     f"the process count ({nproc}) for multi-host predict")
             local_dp = dp // nproc
             per_host_bs = -(-per_host_bs // local_dp) * local_dp
-        for bx, _ in ds.batches(per_host_bs, shuffle=False,
-                                drop_remainder=False):
+        def _prep(batch):
+            """Host-side pad + device_put — runs on the prefetch thread,
+            overlapped with the previous batch's device compute."""
+            bx, _ = batch
             pad = 0
             first = bx[0] if isinstance(bx, (tuple, list)) else bx
             if len(first) < per_host_bs:
                 # pad the trailing batch to keep one compiled shape
                 pad = per_host_bs - len(first)
                 bx = _pad_tail(bx, pad)
-            bx, _ = self._put_batch(bx, None)
+            placed, _ = self._put_batch(bx, None)
+            return placed, pad
+
+        from ..common.prefetch import prefetch
+        dev_it = prefetch(ds.batches(per_host_bs, shuffle=False,
+                                     drop_remainder=False), _prep)
+        for bx, pad in dev_it:
             y = self._predict_step(self.state.params, self.state.model_state,
                                    bx)
             # multi-host: fetch only the rows this host fed

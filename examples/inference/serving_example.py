@@ -14,6 +14,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--concurrency", type=int, default=4)
     ap.add_argument("--quantize", action="store_true")
+    ap.add_argument("--coalesce", action="store_true",
+                    help="pack concurrent callers into one padded "
+                         "device dispatch (serving fast path)")
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
     args = ap.parse_args()
 
     from analytics_zoo_tpu.pipeline.api.keras.engine import Sequential
@@ -29,8 +33,14 @@ def main():
     model.fit(rs.rand(128, 16).astype(np.float32),
               rs.randint(0, 4, 128), batch_size=32, nb_epoch=1)
 
-    served = InferenceModel(supported_concurrent_num=args.concurrency)
+    served = InferenceModel(supported_concurrent_num=args.concurrency,
+                            max_batch_size=32,
+                            coalescing=args.coalesce,
+                            max_wait_ms=args.max_wait_ms)
     served.load_keras_net(model, quantize=args.quantize)
+    if not args.quantize:
+        # AOT-compile the whole bucket ladder before traffic arrives
+        served.warmup((16,))
 
     results = {}
 
@@ -44,8 +54,12 @@ def main():
         t.start()
     for t in threads:
         t.join()
+    stats = served.serving_stats()
     print(f"served {len(results)} concurrent requests; "
-          f"output shape {results[0].shape}; quantized={args.quantize}")
+          f"output shape {results[0].shape}; quantized={args.quantize}; "
+          f"buckets {stats['buckets']} misses {stats['misses']} "
+          f"dispatches {stats['dispatches']}")
+    served.close()
 
 
 if __name__ == "__main__":

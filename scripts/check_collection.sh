@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Fast collection gate: `pytest tests/ -q --co` must exit 0.
+#
+# A single bad import once zeroed out the whole suite silently (the
+# `from jax import shard_map` drift killed 40+ test modules at
+# COLLECTION on jax 0.4.37, so "0 failed" meant "0 collected").  Run
+# this before the suite — it takes seconds and fails loudly on the
+# first broken import.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q --co \
+    -p no:cacheprovider "$@" > /dev/null
+echo "collection OK"
