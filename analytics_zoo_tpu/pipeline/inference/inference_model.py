@@ -93,6 +93,10 @@ class InferenceModel:
         self.max_wait_ms = float(max_wait_ms)
         self._cache: Optional[BucketedExecutableCache] = None
         self._coalescer: Optional[RequestCoalescer] = None
+        # (predict_fn, cache, coalescer) published as ONE tuple: a
+        # predict() racing reload() snapshots a consistent path — never
+        # the new forward with the old bucket cache or vice versa
+        self._fastpath = None
 
     # ---- loading (reference load/loadCaffe/loadTF surface) ----
     def load(self, model_path: str, weight_path: Optional[str] = None,
@@ -192,24 +196,38 @@ class InferenceModel:
         it: bucketed executable cache + optional coalescer.  Quantized
         handles stay on the exact-shape path — their dynamic activation
         scales are batch-global, so padded filler rows would change
-        real-row outputs."""
-        self._predict_fn = predict_fn
-        if self._coalescer is not None:
-            self._coalescer.close()
-            self._coalescer = None
-        self._cache = None
+        real-row outputs.
+
+        Reload ordering (the zero-downtime contract): the NEW path is
+        fully built and published first, THEN the old coalescer is
+        closed — its already-queued requests drain through the OLD
+        executables while new traffic flows to the new ones.  No request
+        is ever abandoned or served by a half-swapped path."""
+        old_coalescer = self._coalescer
+        cache = None
+        coalescer = None
         if self._bucketing and not getattr(self, "_quantize_flag", False):
-            self._cache = BucketedExecutableCache(
+            cache = BucketedExecutableCache(
                 predict_fn, max_batch=self.max_batch_size,
                 buckets=self._buckets, growth=self._bucket_growth)
             if self._coalescing:
                 # pipeline two dispatches when the concurrency budget
                 # allows — the device computes group k while group k+1
                 # is gathered and dispatched behind it
-                self._coalescer = RequestCoalescer(
-                    self._cache, max_wait_ms=self.max_wait_ms,
+                coalescer = RequestCoalescer(
+                    cache, max_wait_ms=self.max_wait_ms,
                     semaphore=self._semaphore,
                     pipeline_depth=min(2, self.concurrent_num))
+        # one assignment publishes the whole new path (GIL-atomic)
+        self._fastpath = (predict_fn, cache, coalescer)
+        self._predict_fn = predict_fn
+        self._cache = cache
+        self._coalescer = coalescer
+        if old_coalescer is not None:
+            # graceful drain: queued requests complete on the old
+            # executables; anything racing the shutdown gets
+            # CoalescerClosedError and the caller falls back
+            old_coalescer.close()
 
     # ---- serving fast path surface ----
     def warmup(self, sample_shapes, dtypes=None) -> float:
@@ -227,16 +245,24 @@ class InferenceModel:
 
     def serving_stats(self) -> dict:
         """Per-bucket hit/miss/compile-time counters plus coalescer
-        dispatch stats."""
+        dispatch stats (consumed directly and re-exported per model by
+        the serving control plane's metrics snapshot)."""
         out = {"buckets": (), "hits": {}, "misses": {},
                "compile_time_s": {}, "dispatches": 0,
-               "coalesced_requests": 0}
-        if self._cache is not None:
-            out["buckets"] = self._cache.buckets
-            out.update(self._cache.stats.snapshot())
-        if self._coalescer is not None:
-            out["dispatches"] = self._coalescer.dispatches
-            out["coalesced_requests"] = self._coalescer.coalesced_requests
+               "coalesced_requests": 0, "coalescer_pending": 0}
+        # snapshot the triple so a metrics read during reload() never
+        # pairs the new cache's counters with the old coalescer's
+        fastpath = self._fastpath
+        if fastpath is None:
+            return out
+        _, cache, coalescer = fastpath
+        if cache is not None:
+            out["buckets"] = cache.buckets
+            out.update(cache.stats.snapshot())
+        if coalescer is not None:
+            out["dispatches"] = coalescer.dispatches
+            out["coalesced_requests"] = coalescer.coalesced_requests
+            out["coalescer_pending"] = coalescer.pending
         return out
 
     def close(self):
@@ -255,15 +281,16 @@ class InferenceModel:
         """Accepts one batch array, a JTensor, a list of per-sample inputs,
         or a list of input-lists for multi-input models; returns
         predictions in the matching container type."""
-        if self._predict_fn is None:
+        fastpath = self._fastpath  # ONE read: consistent under reload()
+        if fastpath is None:
             raise RuntimeError("InferenceModel: no model loaded")
+        predict_fn, cache, coalescer = fastpath
         batched, single, jtensor = self._normalize(inputs)
-        cache, coalescer = self._cache, self._coalescer  # racing reload()
         if cache is None:
             # exact-shape path (bucketing off, or quantized handle whose
             # batch-global activation scales forbid padding)
             with self._semaphore:
-                out = self._predict_fn(batched)
+                out = predict_fn(batched)
             out = np.asarray(jax.device_get(out))
         else:
             out = None

@@ -26,6 +26,7 @@ keep those on the exact-shape path (``InferenceModel`` does).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import queue
 import threading
@@ -303,6 +304,9 @@ class RequestCoalescer:
         # blocking on a full queue must not deadlock the dispatcher's
         # _done() accounting.
         self._submit_lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self._crashed = False
+        self._inflight: "collections.deque" = collections.deque()
         self._thread = threading.Thread(
             target=self._loop, name="zoo-serving-dispatch", daemon=True)
         self._thread.start()
@@ -312,6 +316,12 @@ class RequestCoalescer:
         """True once close() ran or the dispatcher died — submits would
         never be served."""
         return self._closed or not self._thread.is_alive()
+
+    @property
+    def pending(self) -> int:
+        """Submitted-but-unresolved request count (queued + in flight)."""
+        with self._out_lock:
+            return self._outstanding
 
     def submit(self, batched) -> Future:
         n = _rows(batched)
@@ -328,14 +338,51 @@ class RequestCoalescer:
             with self._out_lock:
                 self._outstanding += 1
             self._q.put(req)
+        if self._crashed or not self._thread.is_alive():
+            # the dispatcher died between the aliveness check and the
+            # enqueue — its crash-net drain may already have run, so
+            # nobody would ever serve (or fail) this request.  Flush it
+            # (and anything else stranded) ourselves.  ``_crashed`` is
+            # set BEFORE the crash net's flush, so even a put that was
+            # blocked on a full queue (and only completed because that
+            # flush freed a slot, while the crashing thread still reads
+            # as alive) observes it here.
+            self._flush_queue(CoalescerClosedError(
+                "RequestCoalescer dispatcher died"))
         return req.future
 
     def _done(self, k: int):
         with self._out_lock:
             self._outstanding -= k
 
+    def _flush_queue(self, exc: BaseException):
+        """Fail every queued (never-dispatched) request with ``exc``.
+        Only safe once no dispatcher owns the queue: closed-and-joined,
+        crashed, or from the crash net itself.  ``_flush_lock``
+        serializes the crash net against a concurrent submit-side flush
+        (both may race to fail the same carry)."""
+        with self._flush_lock:
+            leftovers, self._carry = (
+                [self._carry] if self._carry is not None else []), None
+            try:
+                while True:
+                    r = self._q.get_nowait()
+                    if r is not _SHUTDOWN:
+                        leftovers.append(r)
+            except queue.Empty:
+                pass
+            # flushed requests leave the live count too — ``pending``
+            # must not report phantom requests on a dead coalescer
+            self._done(len(leftovers))
+            for r in leftovers:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+
     def close(self, timeout: float = 5.0):
-        """Stop the dispatcher; fail any request racing the shutdown
+        """Stop the dispatcher: already-queued requests are SERVED (the
+        shutdown sentinel sits behind them in the queue — this is the
+        graceful drain reload()/the registry rely on), then anything
+        racing the shutdown fails with CoalescerClosedError
         (idempotent)."""
         with self._submit_lock:
             already = self._closed
@@ -349,19 +396,7 @@ class RequestCoalescer:
             # it still owns _carry and the queue, so leave both alone;
             # it will drain to the sentinel and exit on its own
             return
-        leftovers, self._carry = (
-            [self._carry] if self._carry is not None else []), None
-        try:
-            while True:
-                r = self._q.get_nowait()
-                if r is not _SHUTDOWN:
-                    leftovers.append(r)
-        except queue.Empty:
-            pass
-        for r in leftovers:
-            if not r.future.done():
-                r.future.set_exception(
-                    CoalescerClosedError("RequestCoalescer closed"))
+        self._flush_queue(CoalescerClosedError("RequestCoalescer closed"))
 
     # ---- dispatcher ----
     def _gather(self, block: bool,
@@ -486,21 +521,34 @@ class RequestCoalescer:
         try:
             self._loop_inner()
         except BaseException as e:  # crash net: never strand a caller
-            carry, self._carry = self._carry, None
-            if carry is not None and not carry.future.done():
-                carry.future.set_exception(e)
-            try:
-                while True:
-                    r = self._q.get_nowait()
-                    if r is not _SHUTDOWN and not r.future.done():
+            # mark closed BEFORE draining so a submit racing this drain
+            # either sees closed (and raises) or enqueues before the
+            # drain starts (and is flushed here).  acquire with a
+            # timeout: a submitter blocked on a full queue holds
+            # _submit_lock and would never release it once we're dead —
+            # submit()'s own post-put aliveness check covers that case.
+            got = self._submit_lock.acquire(timeout=1.0)
+            self._closed = True
+            self._crashed = True  # before the flush — see submit()
+            if got:
+                self._submit_lock.release()
+            self._flush_queue(e)
+            # dispatched-but-unresolved groups die with us too: fail
+            # their callers and return their device-concurrency slots
+            # (a leaked slot would wedge the solo fallback path)
+            while self._inflight:
+                group, _, _ = self._inflight.popleft()
+                self._done(len(group))
+                for r in group:
+                    if not r.future.done():
                         r.future.set_exception(e)
-            except queue.Empty:
-                pass
+                if self._sem is not None:
+                    self._sem.release()
             raise
 
     def _loop_inner(self):
-        import collections
-        inflight: "collections.deque" = collections.deque()
+        # instance-held so the crash net can fail dispatched groups
+        inflight = self._inflight
         shutdown = False
         while True:
             group: List[_Request] = []

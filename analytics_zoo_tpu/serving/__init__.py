@@ -1,0 +1,17 @@
+"""Serving control plane: multi-model registry, zero-downtime hot-swap,
+admission control & load shedding, canary traffic splitting, and a
+metrics snapshot API — the lifecycle layer over the
+``pipeline.inference`` data plane (bucketed executables + request
+coalescing).  See docs/serving.md §"Control plane"."""
+
+from .admission import AdmissionController
+from .errors import (DeadlineExceeded, DeployError, ModelNotFound,
+                     Overloaded, ServingError, error_response)
+from .metrics import Counters, LatencyWindow
+from .registry import ModelRegistry
+
+__all__ = [
+    "AdmissionController", "Counters", "DeadlineExceeded", "DeployError",
+    "LatencyWindow", "ModelNotFound", "ModelRegistry", "Overloaded",
+    "ServingError", "error_response",
+]

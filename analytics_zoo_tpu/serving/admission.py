@@ -1,0 +1,200 @@
+"""Admission control: bounded queueing, concurrency limiting, and
+deadline-aware load shedding for one served model.
+
+The naive failure mode this prevents (and PR 1's data plane still had):
+under overload the coalescer queue grows without bound, every request
+"succeeds" seconds too late, and by the time the client times out the
+server has still done the work.  An ``AdmissionController`` makes
+overload EXPLICIT and IMMEDIATE instead:
+
+* the wait queue is bounded (``max_queue``) — request #Q+1 is rejected
+  with a structured :class:`~.errors.Overloaded` in microseconds, not
+  parked;
+* at most ``max_concurrency`` requests occupy the data plane at once
+  (the coalescer still packs them into shared dispatches underneath);
+* a request with a deadline is SHED AT ADMISSION when the predicted
+  queue wait + service time (an EWMA of observed service times) already
+  overruns it — :class:`~.errors.DeadlineExceeded` with ``shed=True``,
+  before it consumes any capacity.  A request whose deadline lapses
+  while waiting for a slot is also failed immediately at lapse time;
+* ``drain()`` is the graceful-shutdown half: stop admitting, let
+  everything already admitted (queued or running) finish.
+
+Usage::
+
+    ac = AdmissionController(max_queue=64, max_concurrency=4)
+    with ac.admit(deadline_ms=50):     # may raise Overloaded/DeadlineExceeded
+        out = model.predict(x)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+from .errors import DeadlineExceeded, Overloaded
+from .metrics import Counters
+
+
+class AdmissionController:
+    """Bounded queue + concurrency limit + deadline-aware shedding."""
+
+    def __init__(self, max_queue: int = 64, max_concurrency: int = 4,
+                 default_deadline_ms: Optional[float] = None,
+                 ewma_alpha: float = 0.2):
+        if max_queue < 1:
+            # _waiting transiently covers a request about to take a
+            # free slot, so the strict bound needs at least one seat
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if max_concurrency < 1:
+            raise ValueError(
+                f"max_concurrency must be >= 1, got {max_concurrency}")
+        self.max_queue = int(max_queue)
+        self.max_concurrency = int(max_concurrency)
+        self.default_deadline_ms = default_deadline_ms
+        self._alpha = float(ewma_alpha)
+        self._cond = threading.Condition()
+        self._waiting = 0            # admitted, waiting for a slot
+        self._running = 0            # holding a concurrency slot
+        self._queue_high_water = 0
+        self._draining = False
+        self._service_ewma_s: Optional[float] = None
+        self.counters = Counters(
+            "admitted", "completed", "errors", "shed_overload",
+            "shed_deadline", "shed_draining", "deadline_lapsed")
+
+    # ---- admission ----
+    @contextlib.contextmanager
+    def admit(self, deadline_ms: Optional[float] = None):
+        """Admit (or shed) one request; run the service call in the
+        ``with`` body.  Raises Overloaded / DeadlineExceeded instead of
+        queueing hopeless work."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        t0 = time.perf_counter()
+        deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
+        self._acquire(t0, deadline, deadline_ms)
+        t_service = time.perf_counter()
+        try:
+            yield
+        except BaseException:
+            self._release(t_service, error=True)
+            raise
+        self._release(t_service, error=False)
+
+    def _predicted_wait_s(self) -> Optional[float]:
+        """Predicted time to COMPLETE a request admitted now: full
+        rounds of service ahead of it in the queue, plus its own
+        service.  None until a service time has been observed (the
+        first requests are never predictively shed — there is nothing
+        to predict from)."""
+        if self._service_ewma_s is None:
+            return None
+        rounds_ahead = self._waiting / float(self.max_concurrency)
+        return self._service_ewma_s * (rounds_ahead + 1.0)
+
+    def _acquire(self, t0: float, deadline: Optional[float],
+                 deadline_ms: Optional[float]):
+        with self._cond:
+            if self._draining:
+                self.counters.inc("shed_draining")
+                raise Overloaded("model is draining — not admitting",
+                                 queue_depth=self._waiting,
+                                 draining=True)
+            if self._waiting >= self.max_queue:
+                self.counters.inc("shed_overload")
+                raise Overloaded(
+                    "admission queue full",
+                    queue_depth=self._waiting, max_queue=self.max_queue)
+            if deadline is not None:
+                est = self._predicted_wait_s()
+                if est is not None and t0 + est > deadline:
+                    self.counters.inc("shed_deadline")
+                    raise DeadlineExceeded(
+                        "deadline cannot be met at current queue depth",
+                        shed=True,
+                        predicted_ms=round(est * 1e3, 3),
+                        deadline_ms=deadline_ms,
+                        queue_depth=self._waiting)
+            self._waiting += 1
+            if self._waiting > self._queue_high_water:
+                self._queue_high_water = self._waiting
+            got_slot = False
+            try:
+                while self._running >= self.max_concurrency:
+                    remaining = (None if deadline is None
+                                 else deadline - time.perf_counter())
+                    if remaining is not None and remaining <= 0:
+                        self.counters.inc("deadline_lapsed")
+                        raise DeadlineExceeded(
+                            "deadline lapsed waiting for a slot",
+                            shed=False,
+                            waited_ms=round(
+                                (time.perf_counter() - t0) * 1e3, 3),
+                            deadline_ms=deadline_ms)
+                    self._cond.wait(timeout=remaining)
+                got_slot = True
+            finally:
+                self._waiting -= 1
+                if got_slot:
+                    self._running += 1
+                    self.counters.inc("admitted")
+                else:
+                    # our departure may unblock drain()'s wait
+                    self._cond.notify_all()
+
+    def _release(self, t_service: float, error: bool):
+        dt = time.perf_counter() - t_service
+        with self._cond:
+            self._running -= 1
+            self.counters.inc("errors" if error else "completed")
+            # errors count toward the EWMA too: a failing model still
+            # consumes service time, and shedding must see that
+            if self._service_ewma_s is None:
+                self._service_ewma_s = dt
+            else:
+                self._service_ewma_s += self._alpha * (
+                    dt - self._service_ewma_s)
+            self._cond.notify_all()
+
+    # ---- shutdown ----
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Graceful shutdown: stop admitting NEW requests (they get
+        Overloaded) but let everything already admitted — queued or
+        running — finish.  Returns True when fully drained within
+        ``timeout``."""
+        end = time.monotonic() + timeout
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while self._waiting or self._running:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+            return True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # ---- introspection ----
+    def snapshot(self) -> dict:
+        with self._cond:
+            c = self.counters.snapshot()
+            c["shed"] = (c["shed_overload"] + c["shed_deadline"]
+                         + c["shed_draining"] + c["deadline_lapsed"])
+            return {
+                "queue_depth": self._waiting,
+                "running": self._running,
+                "queue_high_water": self._queue_high_water,
+                "max_queue": self.max_queue,
+                "max_concurrency": self.max_concurrency,
+                "draining": self._draining,
+                "service_ewma_ms": (
+                    None if self._service_ewma_s is None
+                    else round(self._service_ewma_s * 1e3, 3)),
+                **c,
+            }

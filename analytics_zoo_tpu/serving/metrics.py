@@ -1,0 +1,73 @@
+"""Serving metrics primitives: a sliding latency window and a plain
+counter bag, both thread-safe and snapshot-oriented (the control plane
+exposes point-in-time dicts, consumable as-is by ``GET /metrics``)."""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+
+class LatencyWindow:
+    """Sliding window of the most recent N request latencies with
+    percentile snapshots.
+
+    A bounded deque, not a histogram: serving windows are small enough
+    (default 2048 samples) that exact percentiles over the raw samples
+    are cheaper and more faithful than bucket interpolation, and the
+    window self-ages — a traffic spike's tail latencies wash out after
+    N fresh requests instead of polluting a cumulative histogram
+    forever.
+    """
+
+    def __init__(self, maxlen: int = 2048):
+        self._samples: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self._count = 0
+        self._total_s = 0.0
+
+    def add(self, seconds: float):
+        with self._lock:
+            self._samples.append(seconds)
+            self._count += 1
+            self._total_s += seconds
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            data = sorted(self._samples)
+            count, total = self._count, self._total_s
+
+        def pick(pct):
+            if not data:
+                return None
+            k = min(len(data) - 1,
+                    max(0, int(round((pct / 100.0) * (len(data) - 1)))))
+            return round(data[k] * 1e3, 3)
+
+        return {"count": count,
+                "mean_ms": (round(total / count * 1e3, 3)
+                            if count else None),
+                "p50_ms": pick(50), "p90_ms": pick(90),
+                "p99_ms": pick(99),
+                "window": len(data)}
+
+
+class Counters:
+    """A named bag of monotonically-increasing integers."""
+
+    def __init__(self, *names: str):
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {n: 0 for n in names}
+
+    def inc(self, name: str, by: int = 1):
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + by
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c)

@@ -1,0 +1,418 @@
+"""ModelRegistry: named, versioned models with zero-downtime hot-swap,
+canary traffic splitting, and per-model admission control.
+
+The control plane over PR 1's per-model data plane (bucketed
+executables + request coalescing in ``pipeline/inference``).  The
+reference analog is the POJO serving API behind the web-service sample:
+a process-wide, thread-safe serving surface whose value is the
+LIFECYCLE around the compute — deploy, swap, shed, observe — not the
+forward pass itself.
+
+Deploy protocol (the zero-downtime contract)::
+
+    registry.deploy("ncf", net, warmup_shapes=(2,))
+
+1. a FRESH ``InferenceModel`` is built and loaded for the new version —
+   the live version's executables are never touched;
+2. ``warmup()`` AOT-compiles the new version's whole bucket ladder TO
+   COMPLETION while the old version keeps serving — live traffic never
+   pays a trace;
+3. the active-version pointer is swapped atomically (one reference
+   assignment; every request reads it exactly once, so each response is
+   computed ENTIRELY by the old or entirely by the new version);
+4. the old version's coalescer is closed, which DRAINS it: its queued
+   requests complete on the old executables, then the dispatcher exits.
+
+If step 1 or 2 fails, the new model is discarded and
+:class:`~.errors.DeployError` is raised — the previous version was
+never unplugged, so rollback is a no-op (it just keeps serving).
+
+Every request passes the model's :class:`~.admission.AdmissionController`
+(bounded queue, concurrency limit, deadline-aware shedding), and
+``metrics()`` snapshots the whole plane: per-version latency
+percentiles, admission/shed counters, swap counts, and the data plane's
+own ``BucketStats`` re-exported per model.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .admission import AdmissionController
+from .errors import DeployError, ModelNotFound
+from .metrics import Counters, LatencyWindow
+
+_RETIRED_KEPT = 4  # retired versions whose metrics stay inspectable
+
+
+class _Deployment:
+    """One version of one model: the serving handle + its counters."""
+
+    def __init__(self, version: int, model):
+        self.version = version
+        self.model = model
+        self.state = "staged"  # staged -> active/canary -> retired
+        self.latency = LatencyWindow()
+        self.counters = Counters("requests", "errors")
+        self.deployed_at = time.time()
+
+    def stats(self) -> Dict[str, Any]:
+        return {"state": self.state, **self.counters.snapshot(),
+                "latency": self.latency.snapshot()}
+
+
+class _Entry:
+    """Registry slot for one model name."""
+
+    def __init__(self, name: str, admission: AdmissionController):
+        self.name = name
+        self.lock = threading.RLock()      # control-plane ops (brief)
+        self.route_lock = threading.Lock()  # canary accumulator only
+        # serializes whole deploys (build -> warmup -> swap), which can
+        # take seconds: without it two racing deploys could swap in
+        # either order, leaving the OLDER version active.  Held only by
+        # deploy(); never on the request path.
+        self.deploy_lock = threading.Lock()
+        self.admission = admission
+        self.active: Optional[_Deployment] = None
+        self.canary: Optional[_Deployment] = None
+        self.canary_fraction = 0.0
+        self._canary_acc = 0.0
+        self.retired: List[_Deployment] = []
+        self.swap_count = 0
+        self.next_version = 1
+        self.warmup_shapes = None
+        self.warmup_dtypes = None
+
+
+class ModelRegistry:
+    """Multi-model serving control plane (see module docstring).
+
+    ``model_defaults`` are the ``InferenceModel`` constructor kwargs
+    every deploy starts from (override per-deploy via ``**model_kwargs``);
+    ``max_queue``/``max_concurrency``/``default_deadline_ms`` configure
+    each model's admission controller.
+    """
+
+    def __init__(self, max_queue: int = 64, max_concurrency: int = 4,
+                 default_deadline_ms: Optional[float] = None,
+                 **model_defaults: Any):
+        self._max_queue = max_queue
+        self._max_concurrency = max_concurrency
+        self._default_deadline_ms = default_deadline_ms
+        self._model_defaults = {
+            "supported_concurrent_num": 4, "max_batch_size": 32,
+            "coalescing": True, "max_wait_ms": 2.0, **model_defaults}
+        self._entries: Dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ---- lookup ----
+    def _entry(self, name: str) -> _Entry:
+        e = self._entries.get(name)
+        if e is None:
+            raise ModelNotFound(f"no model deployed under {name!r}",
+                                model=name,
+                                deployed=sorted(self._entries))
+        return e
+
+    def _ensure_entry(self, name: str) -> _Entry:
+        with self._lock:
+            if self._closed:
+                raise DeployError("registry is shut down", model=name)
+            e = self._entries.get(name)
+            if e is None:
+                e = _Entry(name, AdmissionController(
+                    max_queue=self._max_queue,
+                    max_concurrency=self._max_concurrency,
+                    default_deadline_ms=self._default_deadline_ms))
+                self._entries[name] = e
+            return e
+
+    def models(self) -> Dict[str, Optional[int]]:
+        """name -> active version (None while only a canary is staged)."""
+        return {n: (e.active.version if e.active else None)
+                for n, e in list(self._entries.items())}
+
+    # ---- deploy / swap ----
+    def deploy(self, name: str, net=None, *, jax_fn=None, params=None,
+               model=None, version: Optional[int] = None,
+               warmup_shapes=None, warmup_dtypes=None,
+               quantize: Optional[bool] = None,
+               canary_fraction: Optional[float] = None,
+               **model_kwargs: Any) -> int:
+        """Deploy ``net`` (a KerasNet/ZooModel), ``jax_fn``+``params``
+        (a raw jax forward), or a prebuilt serving handle (``model``,
+        anything with predict/warmup/close/serving_stats) as a new
+        version of ``name``.  Returns the version number.
+
+        Warmup runs TO COMPLETION before the swap; on any build/warmup
+        failure the previous version keeps serving and
+        :class:`DeployError` is raised (rollback).  With
+        ``canary_fraction`` the new version is STAGED, not swapped:
+        that fraction of requests routes to it until ``promote(name)``
+        or ``clear_canary(name)``.
+        """
+        if canary_fraction is not None:
+            canary_fraction = float(canary_fraction)
+            # NaN fails this check too (accumulator poison otherwise)
+            if not 0.0 <= canary_fraction <= 1.0:
+                raise ValueError(
+                    f"canary_fraction must be in [0, 1], got "
+                    f"{canary_fraction}")
+        entry = self._ensure_entry(name)
+        # serialize whole deploys for this name: versions are allocated
+        # inside the lock, so swap order always matches version order
+        with entry.deploy_lock:
+            with entry.lock:
+                if version is None:
+                    version = entry.next_version
+                entry.next_version = max(entry.next_version, version + 1)
+            active_v = entry.active.version if entry.active else None
+
+            def fail(stage: str, e: BaseException):
+                raise DeployError(
+                    f"deploy of {name!r} v{version} failed during "
+                    f"{stage} — rolled back (v{active_v} still serving)",
+                    model=name, version=version, active_version=active_v,
+                    stage=stage,
+                    cause=f"{type(e).__name__}: {e}") from e
+
+            # 1. build + load a fresh handle; the live one is never
+            # touched
+            if model is None:
+                from ..pipeline.inference import InferenceModel
+                im = InferenceModel(
+                    **{**self._model_defaults, **model_kwargs})
+                try:
+                    if net is not None:
+                        im.load_keras_net(net, quantize=quantize)
+                    elif jax_fn is not None:
+                        im.load_jax(jax_fn, params)
+                    else:
+                        raise ValueError(
+                            "deploy needs net=, jax_fn=+params=, or "
+                            "model=")
+                except BaseException as e:
+                    im.close()
+                    fail("load", e)
+                model = im
+
+            # 2. warmup to completion BEFORE the swap (deploy pays the
+            # compiles, live traffic never does).  A duck-typed handle
+            # without the bucketed fast path's `_cache` attr is asked
+            # via its own warmup(); an InferenceModel whose cache is
+            # off (bucketing=False / quantized) has no ladder to warm.
+            shapes = (warmup_shapes if warmup_shapes is not None
+                      else entry.warmup_shapes)
+            dtypes = (warmup_dtypes if warmup_dtypes is not None
+                      else entry.warmup_dtypes)
+            warmable = (callable(getattr(model, "warmup", None))
+                        and getattr(model, "_cache", True) is not None)
+            if shapes is not None and warmable:
+                try:
+                    model.warmup(shapes, dtypes)
+                except BaseException as e:
+                    model.close()
+                    fail("warmup", e)
+
+            dep = _Deployment(version, model)
+
+            # 3. atomic pointer swap (or canary staging) + 4. drain old
+            old = None
+            stale = False
+            with entry.lock:
+                with self._lock:
+                    # the registry may have shut down (or this name
+                    # been undeployed) while we were building/warming —
+                    # swapping into a popped entry would leak a live
+                    # model nobody can ever close
+                    stale = (self._closed
+                             or self._entries.get(name) is not entry)
+                if not stale:
+                    if shapes is not None:
+                        entry.warmup_shapes = shapes
+                        entry.warmup_dtypes = dtypes
+                    if canary_fraction is not None:
+                        old = entry.canary
+                        dep.state = "canary"
+                        entry.canary = dep
+                        entry.canary_fraction = float(canary_fraction)
+                        entry._canary_acc = 0.0
+                    else:
+                        old = entry.active
+                        dep.state = "active"
+                        entry.active = dep  # THE swap: one assignment
+                        if old is not None:
+                            entry.swap_count += 1
+            if stale:
+                model.close()
+                raise DeployError(
+                    f"{name!r} was undeployed (or the registry shut "
+                    f"down) while v{version} was building — the new "
+                    "version was discarded", model=name, version=version)
+            self._retire(entry, old)
+        return version
+
+    def promote(self, name: str) -> int:
+        """Make the staged canary the active version (atomic swap,
+        then drain the displaced one).  Returns the promoted version."""
+        entry = self._entry(name)
+        with entry.lock:
+            dep = entry.canary
+            if dep is None:
+                raise ModelNotFound(f"no canary staged for {name!r}",
+                                    model=name)
+            old = entry.active
+            dep.state = "active"
+            entry.active = dep
+            entry.canary = None
+            entry.canary_fraction = 0.0
+            if old is not None:
+                entry.swap_count += 1
+        self._retire(entry, old)
+        return dep.version
+
+    def clear_canary(self, name: str):
+        """Discard the staged canary (the experiment failed)."""
+        entry = self._entry(name)
+        with entry.lock:
+            dep = entry.canary
+            entry.canary = None
+            entry.canary_fraction = 0.0
+        self._retire(entry, dep)
+
+    def _retire(self, entry: _Entry, dep: Optional[_Deployment]):
+        """Close a displaced deployment OUTSIDE the entry lock: close()
+        drains its coalescer (queued requests complete on the old
+        executables), which can take up to the drain timeout."""
+        if dep is None:
+            return
+        dep.state = "retired"
+        dep.model.close()
+        with entry.lock:
+            entry.retired.append(dep)
+            del entry.retired[:-_RETIRED_KEPT]
+
+    # ---- serving ----
+    def predict(self, name: str, inputs, deadline_ms: Optional[float] = None):
+        out, _ = self.predict_ex(name, inputs, deadline_ms=deadline_ms)
+        return out
+
+    def predict_ex(self, name: str, inputs,
+                   deadline_ms: Optional[float] = None
+                   ) -> Tuple[Any, Dict[str, Any]]:
+        """predict + routing info ``{"model", "version", "canary"}`` —
+        the web frontend tags responses with the serving version so
+        clients (and the hot-swap tests) can see which side of a swap
+        produced them.  Raises ModelNotFound / Overloaded /
+        DeadlineExceeded (structured, immediate)."""
+        entry = self._entry(name)
+        with entry.admission.admit(deadline_ms=deadline_ms):
+            dep, is_canary = self._route(entry)
+            t0 = time.perf_counter()
+            try:
+                out = dep.model.predict(inputs)
+            except BaseException:
+                dep.counters.inc("errors")
+                raise
+            dep.latency.add(time.perf_counter() - t0)
+            dep.counters.inc("requests")
+        return out, {"model": name, "version": dep.version,
+                     "canary": is_canary}
+
+    def _route(self, entry: _Entry) -> Tuple[_Deployment, bool]:
+        """Pick the serving version.  Canary routing uses an error
+        accumulator, not randomness: over any run of N requests the
+        canary receives floor/ceil(N * fraction) of them exactly."""
+        canary = entry.canary
+        if canary is not None and entry.canary_fraction > 0.0:
+            with entry.route_lock:
+                # re-read under the lock: promote()/clear may have won
+                if entry.canary is canary:
+                    entry._canary_acc += entry.canary_fraction
+                    if entry._canary_acc >= 1.0:
+                        entry._canary_acc -= 1.0
+                        return canary, True
+        active = entry.active
+        if active is None:
+            raise ModelNotFound(
+                f"model {entry.name!r} has no active version "
+                "(canary-only — promote it first)", model=entry.name)
+        return active, False
+
+    # ---- lifecycle ----
+    def undeploy(self, name: str, drain_timeout: float = 10.0) -> bool:
+        """Remove ``name``: stop admitting, let admitted requests
+        finish (graceful drain), then close every version.  Returns
+        True when the drain completed within ``drain_timeout``."""
+        with self._lock:
+            entry = self._entries.pop(name, None)
+        if entry is None:
+            raise ModelNotFound(f"no model deployed under {name!r}",
+                                model=name)
+        drained = entry.admission.drain(timeout=drain_timeout)
+        # deploy_lock: an in-flight deploy either sees the popped entry
+        # and discards its new model, or swaps before we get here — in
+        # which case entry.active below IS that new model and we close
+        # it.  Either way nothing leaks.
+        with entry.deploy_lock:
+            with entry.lock:
+                deps = [d for d in (entry.active, entry.canary)
+                        if d is not None]
+                entry.active = entry.canary = None
+        for d in deps:
+            d.state = "retired"
+            d.model.close()
+        return drained
+
+    def shutdown(self, drain_timeout: float = 10.0):
+        """Drain and close every model (idempotent)."""
+        with self._lock:
+            self._closed = True
+            names = list(self._entries)
+        for n in names:
+            try:
+                self.undeploy(n, drain_timeout=drain_timeout)
+            except ModelNotFound:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # ---- observability ----
+    def metrics(self, name: Optional[str] = None) -> Dict[str, Any]:
+        """Point-in-time snapshot of the whole control plane (or one
+        model): per-version request counts / error counts / latency
+        percentiles, admission + shed counters, swap count, canary
+        state, and the active version's data-plane ``serving_stats``
+        (bucket hit/miss/compile counters, coalescer dispatch stats)."""
+        entries = ({name: self._entry(name)} if name is not None
+                   else dict(self._entries))
+        out: Dict[str, Any] = {}
+        for n, e in entries.items():
+            with e.lock:
+                active, canary = e.active, e.canary
+                versions = {d.version: d.stats() for d in
+                            (*e.retired, canary, active) if d is not None}
+                canary_info = (None if canary is None else
+                               {"version": canary.version,
+                                "fraction": e.canary_fraction})
+                swaps = e.swap_count
+            serving = (active.model.serving_stats()
+                       if active is not None
+                       and hasattr(active.model, "serving_stats") else {})
+            out[n] = {
+                "active_version": active.version if active else None,
+                "canary": canary_info,
+                "swap_count": swaps,
+                "admission": e.admission.snapshot(),
+                "versions": versions,
+                "serving": serving,
+            }
+        return out

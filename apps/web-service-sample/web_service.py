@@ -1,19 +1,30 @@
-"""Web-service sample: the serving handle behind an HTTP endpoint.
+"""Web-service sample: the serving CONTROL PLANE behind an HTTP endpoint.
 
 Reference analog: apps/web-service-sample — a Spring web service
 consuming the thread-safe POJO serving API
-(AbstractInferenceModel.java:30-148: a queue of weight-sharing model
-replicas serving concurrent requests).  Here the same role is played by
-``InferenceModel`` (semaphore-bounded concurrency over one jitted
-predict function) behind python's stdlib HTTP server.
+(AbstractInferenceModel.java:30-148).  Here the same role is played by
+``ModelRegistry`` (analytics_zoo_tpu.serving): named + versioned
+models, zero-downtime hot-swap, per-model admission control with
+deadline-aware load shedding, and a metrics snapshot.
 
-POST /predict  {"instances": [[...], ...]}  ->  {"predictions": [...]}
-GET  /health                                ->  {"status": "ok"}
+POST /predict {"instances": [[...], ...],              -> {"predictions": [...],
+               "model": "default",       # optional        "model": ..., "version": ...}
+               "deadline_ms": 250}       # optional
+POST /deploy  {"model": "default", "seed": 1,          -> {"model": ..., "version": v}
+               "hidden": 16, "canary_fraction": 0.2}   # canary optional
+POST /promote {"model": "default"}                     -> {"version": v}
+GET  /metrics                                          -> registry.metrics()
+GET  /health                                           -> {"status": "ok"}
+
+Overload/miss surface: 429 Overloaded (queue full / draining),
+504 DeadlineExceeded (shed or lapsed), 404 ModelNotFound — all with a
+structured JSON body {"error": <code>, "message": ..., ...fields}.
 
 Run standalone:  python web_service.py --port 8900
-(then:  curl -d '{"instances": [[0.1, 0.2, ...]]}' localhost:8900/predict)
+(then:  curl -d '{"instances": [[0.1, ...]]}' localhost:8900/predict)
 With --self-test the app starts the server, fires concurrent client
-requests against it, verifies the responses, and exits.
+traffic, HOT-SWAPS the model mid-traffic (zero failed requests, every
+response tagged with exactly one version), checks /metrics, and exits.
 """
 
 import argparse
@@ -23,23 +34,45 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+DEFAULT_MODEL = "default"
+N_FEATURES = 8
+N_CLASSES = 3
 
-def build_model():
-    """A small classifier served by the handle (stand-in for a loaded
-    zoo model; reference services load a pretrained BigDL/TF model)."""
-    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+
+def build_net(hidden: int = 16, seed: int = 0):
+    """A small classifier (stand-in for a loaded zoo model; reference
+    services load a pretrained BigDL/TF model).  ``seed`` varies the
+    weights so a redeploy is an observably different version."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, optimizers
     from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
-    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.train.trainer import Trainer
 
     net = Sequential()
-    net.add(Dense(16, activation="relu", input_shape=(8,)))
-    net.add(Dense(3, activation="softmax"))
-    model = InferenceModel(supported_concurrent_num=4)
-    model.load_keras_net(net)
-    return model
+    net.add(Dense(hidden, activation="relu", input_shape=(N_FEATURES,)))
+    net.add(Dense(N_CLASSES, activation="softmax"))
+    # attach the trainer ourselves to pin the init seed (so a redeploy
+    # with a new seed is an observably different version)
+    net.trainer = Trainer(net.to_graph(), None, optimizers.get("sgd"),
+                          seed=seed)
+    return net
 
 
-def make_handler(model):
+def build_registry():
+    """The control plane: one registry, the default model deployed and
+    warmed before the server accepts traffic."""
+    from analytics_zoo_tpu.serving import ModelRegistry
+
+    registry = ModelRegistry(max_queue=64, max_concurrency=4,
+                             supported_concurrent_num=4,
+                             max_batch_size=32, coalescing=True)
+    registry.deploy(DEFAULT_MODEL, build_net(),
+                    warmup_shapes=(N_FEATURES,))
+    return registry
+
+
+def make_handler(registry):
+    from analytics_zoo_tpu.serving import error_response
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
@@ -52,62 +85,133 @@ def make_handler(model):
             self.end_headers()
             self.wfile.write(body)
 
+        def _body(self):
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n) or b"{}")
+
         def do_GET(self):
             if self.path == "/health":
                 self._reply(200, {"status": "ok"})
+            elif self.path == "/metrics":
+                self._reply(200, registry.metrics())
             else:
                 self._reply(404, {"error": "unknown path"})
 
         def do_POST(self):
-            if self.path != "/predict":
-                self._reply(404, {"error": "unknown path"})
-                return
             try:
-                n = int(self.headers.get("Content-Length", 0))
-                payload = json.loads(self.rfile.read(n) or b"{}")
-                x = np.asarray(payload["instances"], dtype=np.float32)
-                preds = model.predict(x)
-                self._reply(200, {"predictions":
-                                  np.asarray(preds).tolist()})
-            except Exception as e:  # client error surface
-                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                payload = self._body()
+                if self.path == "/predict":
+                    x = np.asarray(payload["instances"], dtype=np.float32)
+                    preds, info = registry.predict_ex(
+                        payload.get("model", DEFAULT_MODEL), x,
+                        deadline_ms=payload.get("deadline_ms"))
+                    self._reply(200, {
+                        "predictions": np.asarray(preds).tolist(), **info})
+                elif self.path == "/deploy":
+                    name = payload.get("model", DEFAULT_MODEL)
+                    net = build_net(hidden=int(payload.get("hidden", 16)),
+                                    seed=int(payload.get("seed", 0)))
+                    frac = payload.get("canary_fraction")
+                    v = registry.deploy(
+                        name, net, warmup_shapes=(N_FEATURES,),
+                        canary_fraction=(None if frac is None
+                                         else float(frac)))
+                    self._reply(200, {"model": name, "version": v})
+                elif self.path == "/promote":
+                    name = payload.get("model", DEFAULT_MODEL)
+                    self._reply(200, {"model": name,
+                                      "version": registry.promote(name)})
+                else:
+                    self._reply(404, {"error": "unknown path"})
+            except Exception as e:  # structured control-plane surface
+                self._reply(*error_response(e))
 
     return Handler
 
 
 def self_test(port: int):
+    """Concurrent clients + a hot-swap mid-traffic: zero failed
+    requests, every response tagged with exactly one version, /metrics
+    coherent afterwards."""
     from urllib.request import Request, urlopen
 
-    def post(payload):
-        req = Request(f"http://127.0.0.1:{port}/predict",
+    def call(path, payload=None):
+        if payload is None:
+            with urlopen(f"http://127.0.0.1:{port}{path}",
+                         timeout=30) as r:
+                return json.loads(r.read())
+        req = Request(f"http://127.0.0.1:{port}{path}",
                       data=json.dumps(payload).encode(),
                       headers={"Content-Type": "application/json"})
         with urlopen(req, timeout=30) as resp:
             return json.loads(resp.read())
 
-    with urlopen(f"http://127.0.0.1:{port}/health", timeout=30) as r:
-        assert json.loads(r.read())["status"] == "ok"
+    assert call("/health")["status"] == "ok"
 
     # payloads drawn up-front: RandomState is not thread-safe
     rs = np.random.RandomState(0)
-    payloads = [rs.rand(4, 8).tolist() for _ in range(8)]
-    results = {}
+    payloads = [rs.rand(4, N_FEATURES).tolist() for _ in range(8)]
+    n_clients = 8
+    results = [[] for _ in range(n_clients)]
+    failures = []
+    go, stop = threading.Event(), threading.Event()
 
     def client(i):
-        out = post({"instances": payloads[i]})
-        results[i] = np.asarray(out["predictions"])
+        go.wait()
+        k = 0
+        while not stop.is_set():
+            try:
+                out = call("/predict",
+                           {"instances": payloads[(i + k) % len(payloads)]})
+                results[i].append(out)
+            except Exception as e:  # noqa: BLE001 — recorded, asserted 0
+                failures.append((i, k, repr(e)))
+            k += 1
 
     threads = [threading.Thread(target=client, args=(i,))
-               for i in range(8)]
+               for i in range(n_clients)]
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
-    assert len(results) == 8
-    for preds in results.values():
-        assert preds.shape == (4, 3)
-        np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-4)
-    print("web-service self-test: 8 concurrent clients OK")
+    go.set()
+    try:
+        # HOT-SWAP while the clients hammer: deploy a different net as
+        # v2.  The deploy blocks through build + full-ladder warmup, so
+        # the clients run against v1 that whole time; a short grace
+        # afterwards guarantees post-swap traffic too.
+        swap = call("/deploy", {"model": DEFAULT_MODEL, "seed": 7,
+                                "hidden": 24})
+        import time as _time
+        _time.sleep(0.5)
+    finally:
+        # a failed deploy must fail the self-test, not strand the
+        # clients looping forever
+        stop.set()
+        for t in threads:
+            t.join()
+
+    assert not failures, f"requests failed across the swap: {failures[:5]}"
+    versions = set()
+    total = 0
+    for outs in results:
+        assert outs, "a client never completed a request"
+        for out in outs:
+            total += 1
+            preds = np.asarray(out["predictions"])
+            assert preds.shape == (4, N_CLASSES)
+            np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-4)
+            versions.add(out["version"])  # tagged: old xor new, never both
+    # traffic must actually straddle the swap: both versions observed
+    assert versions == {1, swap["version"]}, versions
+
+    m = call("/metrics")[DEFAULT_MODEL]
+    assert m["active_version"] == swap["version"]
+    assert m["swap_count"] >= 1
+    assert m["admission"]["errors"] == 0
+    assert m["admission"]["completed"] >= total
+    assert m["serving"]["buckets"], "active version lost its fast path"
+    print(f"web-service self-test: {n_clients} concurrent clients, "
+          f"hot-swap v1->v{swap['version']} mid-traffic, {total} requests, "
+          f"0 failed, versions seen {sorted(versions)} OK")
 
 
 def main():
@@ -116,12 +220,12 @@ def main():
     ap.add_argument("--self-test", action="store_true")
     args = ap.parse_args()
 
-    model = build_model()
+    registry = build_registry()
     server = ThreadingHTTPServer(("127.0.0.1", args.port),
-                                 make_handler(model))
+                                 make_handler(registry))
     port = server.server_address[1]
-    print(f"serving on http://127.0.0.1:{port} "
-          "(POST /predict, GET /health)", flush=True)
+    print(f"serving on http://127.0.0.1:{port} (POST /predict /deploy "
+          "/promote, GET /health /metrics)", flush=True)
     if args.self_test:
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()
@@ -129,8 +233,12 @@ def main():
             self_test(port)
         finally:
             server.shutdown()
+            registry.shutdown()
     else:
-        server.serve_forever()
+        try:
+            server.serve_forever()
+        finally:
+            registry.shutdown()
 
 
 if __name__ == "__main__":

@@ -1252,6 +1252,169 @@ def selftest():
     return 0
 
 
+def _bench_registry(mlp, params, d_in, max_batch, max_wait_ms,
+                    selfcheck: bool):
+    """Control-plane benchmark (ISSUE 2): hot-swap under load — p99 in
+    the swap window vs steady state, with the new version's warmup
+    (full ladder recompile) paid OFF the serving path — and shed rate
+    at 2x over-admission against a bounded queue.  Returns
+    (results_dict, selfcheck_ok); the selfcheck gate is zero request
+    errors across the swap (and the queue bound holding)."""
+    import threading
+
+    import numpy as np
+
+    from analytics_zoo_tpu.serving import (DeadlineExceeded,
+                                           ModelRegistry, Overloaded)
+
+    rng = np.random.default_rng(7)
+    xs = [rng.normal(size=(1, d_in)).astype(np.float32)
+          for _ in range(32)]
+    out = {}
+    ok = True
+    lock = threading.Lock()
+
+    # ---- hot-swap under load ----
+    reg = ModelRegistry(max_queue=512, max_concurrency=4,
+                        supported_concurrent_num=4,
+                        max_batch_size=max_batch, coalescing=True,
+                        max_wait_ms=max_wait_ms)
+    reg.deploy("mlp", jax_fn=mlp, params=params, warmup_shapes=(d_in,))
+    # a REAL new version: different weights => a fresh jit closure, so
+    # deploy pays a full ladder recompile in warmup before the swap
+    p2 = {k: (np.asarray(v) * 1.01).astype(np.float32)
+          for k, v in params.items()}
+    records, errors = [], []
+    stop = threading.Event()
+
+    def client(tid):
+        k = 0
+        while not stop.is_set():
+            x = xs[(tid + k) % len(xs)]
+            t0 = time.perf_counter()
+            try:
+                _, info = reg.predict_ex("mlp", x)
+                with lock:
+                    records.append((time.perf_counter(),
+                                    time.perf_counter() - t0,
+                                    info["version"]))
+            except Exception as e:  # gated: must stay empty
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+            k += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(8)]
+    [t.start() for t in threads]
+    try:
+        time.sleep(1.2)                     # steady state on v1
+        t_swap0 = time.perf_counter()
+        reg.deploy("mlp", jax_fn=mlp, params=p2)  # warmup, then swap
+        t_swap1 = time.perf_counter()
+        time.sleep(1.2)                     # steady state on v2
+    finally:
+        # a deploy failure must fail the bench, not wedge it: the
+        # clients only exit via stop
+        stop.set()
+        [t.join() for t in threads]
+        reg.shutdown()
+
+    def p99(win):
+        lats = [l for (t, l, _) in records if win(t)]
+        if len(lats) < 5:
+            return None
+        return round(float(np.percentile(np.asarray(lats) * 1e3, 99)), 3)
+
+    pad = 0.1  # swap-window tail: in-flight riders finishing on v1
+    steady = p99(lambda t: t < t_swap0)
+    during = p99(lambda t: t_swap0 <= t <= t_swap1 + pad)
+    after = p99(lambda t: t > t_swap1 + pad)
+    versions = sorted({v for (_, _, v) in records})
+    out["hot_swap"] = {
+        "requests": len(records), "errors": len(errors),
+        "steady_p99_ms": steady, "swap_window_p99_ms": during,
+        "post_swap_p99_ms": after,
+        "p99_blip_x": (round(during / steady, 2)
+                       if steady and during else None),
+        "swap_wall_s": round(t_swap1 - t_swap0, 3),
+        "versions_seen": versions}
+    if errors:
+        out["hot_swap"]["first_errors"] = errors[:3]
+    _log(f"registry hot-swap: {len(records)} reqs, {len(errors)} errors,"
+         f" p99 steady {steady} / swap-window {during} / after {after} "
+         f"ms, swap wall {out['hot_swap']['swap_wall_s']}s, "
+         f"versions {versions}")
+    if selfcheck:
+        if errors:
+            _log(f"registry selfcheck FAIL: {len(errors)} request "
+                 f"errors across the swap: {errors[:3]}")
+            ok = False
+        if versions != [1, 2]:
+            _log("registry selfcheck FAIL: traffic did not straddle "
+                 f"the swap (versions {versions})")
+            ok = False
+
+    # ---- shed rate at 2x over-admission ----
+    Q, C = 8, 2
+    reg = ModelRegistry(max_queue=Q, max_concurrency=C,
+                        supported_concurrent_num=C,
+                        max_batch_size=max_batch, coalescing=False)
+    reg.deploy("mlp", jax_fn=mlp, params=params, warmup_shapes=(d_in,))
+    n_threads = 2 * (Q + C)  # 2x the whole admission capacity
+    per_thread = 20
+    comp, shed, rej_lat, other = [], [], [], []
+
+    def shed_client(tid):
+        for k in range(per_thread):
+            x = xs[(tid + k) % len(xs)]
+            t0 = time.perf_counter()
+            try:
+                reg.predict("mlp", x, deadline_ms=10_000.0)
+                with lock:
+                    comp.append(time.perf_counter() - t0)
+            except (Overloaded, DeadlineExceeded) as e:
+                with lock:
+                    shed.append(type(e).__name__)
+                    rej_lat.append(time.perf_counter() - t0)
+            except Exception as e:  # gated: must stay empty
+                with lock:
+                    other.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=shed_client, args=(i,))
+               for i in range(n_threads)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    snap = reg.metrics("mlp")["mlp"]["admission"]
+    reg.shutdown()
+    total = n_threads * per_thread
+    out["shed"] = {
+        "offered_threads": n_threads, "requests": total,
+        "completed": len(comp), "shed": len(shed),
+        "shed_rate": round(len(shed) / total, 3),
+        "queue_high_water": snap["queue_high_water"],
+        "max_queue": Q, "max_concurrency": C,
+        "accepted_p99_ms": (round(float(np.percentile(
+            np.asarray(comp) * 1e3, 99)), 3) if comp else None),
+        "rejection_p99_ms": (round(float(np.percentile(
+            np.asarray(rej_lat) * 1e3, 99)), 3) if rej_lat else None),
+        "errors": len(other)}
+    _log(f"registry shed: {total} reqs from {n_threads} threads over "
+         f"Q={Q} C={C} -> {len(shed)} shed "
+         f"({out['shed']['shed_rate']:.0%}), queue high-water "
+         f"{snap['queue_high_water']}, rejection p99 "
+         f"{out['shed']['rejection_p99_ms']} ms")
+    if selfcheck:
+        if other:
+            _log(f"registry selfcheck FAIL: non-admission errors under "
+                 f"overload: {other[:3]}")
+            ok = False
+        if snap["queue_high_water"] > Q:
+            _log(f"registry selfcheck FAIL: queue depth "
+                 f"{snap['queue_high_water']} exceeded bound {Q}")
+            ok = False
+    return out, ok
+
+
 def serving_bench(n_requests: int = 400, d_in: int = 64, d_hidden: int = 64,
                   n_layers: int = 192, max_batch: int = 32,
                   concurrencies=(1, 8, 32), max_wait_ms: float = 20.0,
@@ -1405,6 +1568,12 @@ def serving_bench(n_requests: int = 400, d_in: int = 64, d_hidden: int = 64,
                 ok = False
     coal_im.close()
     solo_im.close()
+    # ---- control plane: hot-swap blip + shed rate (ISSUE 2) ----
+    reg_results, reg_ok = _bench_registry(
+        mlp, params, d_in, max_batch, max_wait_ms, selfcheck)
+    results["registry"] = reg_results
+    if selfcheck and not reg_ok:
+        ok = False
     # emitted AFTER the selfcheck retries so the archived numbers match
     # the gate verdict
     print("BENCH_SERVING " + json.dumps(results), flush=True)

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Serving smoke gate: the web-service sample's --self-test end to end
+# on CPU — registry deploy + warmup, concurrent clients, a hot-swap
+# mid-traffic with zero failed requests, and a coherent /metrics.
+#
+# Runnable standalone (like check_collection.sh) and cheap enough for
+# CI: one process, ~1 min on a cold CPU.  The timeout wrapper keeps a
+# wedged dispatcher/server from hanging the gate forever.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    python apps/web-service-sample/web_service.py --self-test
+echo "serving smoke OK"

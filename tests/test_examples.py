@@ -110,7 +110,8 @@ class TestApps:
     def test_web_service_app(self):
         out = run_example("apps/web-service-sample/web_service.py",
                           "--self-test")
-        assert "8 concurrent clients OK" in out
+        assert "hot-swap v1->v2 mid-traffic" in out
+        assert "0 failed" in out
 
     def test_augmentation_3d_app(self):
         out = run_example("apps/image-augmentation-3d/augmentation_3d.py")

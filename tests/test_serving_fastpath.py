@@ -20,7 +20,8 @@ import pytest
 from analytics_zoo_tpu.pipeline.api.keras import Sequential
 from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Embedding, Flatten
 from analytics_zoo_tpu.pipeline.inference import (
-    BucketedExecutableCache, InferenceModel, RequestCoalescer, bucket_ladder)
+    BucketedExecutableCache, CoalescerClosedError, InferenceModel,
+    RequestCoalescer, bucket_ladder)
 from analytics_zoo_tpu.pipeline.inference.serving import batch_signature
 
 
@@ -257,6 +258,121 @@ def test_coalescer_oversize_request_takes_solo_path():
     x = np.zeros((9, 2), np.float32)  # > max_batch → chunked solo path
     np.testing.assert_array_equal(im.predict(x), x + 1.0)
     im.close()
+
+
+def test_reload_concurrent_with_predict_never_fails_or_tears():
+    """Pinned (ISSUE 2): reload/load_jax under live predict() traffic —
+    the old coalescer is drained, never abandoned; every call returns a
+    result computed ENTIRELY by one installed version (the fast path is
+    published as one atomic triple) and none fails."""
+    im = InferenceModel(supported_concurrent_num=2, max_batch_size=8,
+                        coalescing=True, max_wait_ms=1.0)
+
+    def fn(p, x):
+        return x * p["s"]
+
+    im.load_jax(fn, {"s": np.float32(1.0)})
+    x = np.arange(6, dtype=np.float32).reshape(2, 3) + 1.0
+    scales = (1.0, 2.0, 3.0, 4.0)
+    results, failures = [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                out = np.asarray(im.predict(x))
+                with lock:
+                    results.append(out)
+            except Exception as e:  # noqa: BLE001 — asserted empty
+                with lock:
+                    failures.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    [t.start() for t in threads]
+    import time
+    try:
+        for s in scales[1:]:
+            time.sleep(0.1)
+            im.load_jax(fn, {"s": np.float32(s)})  # reload mid-traffic
+        time.sleep(0.1)
+    finally:
+        stop.set()  # a failed reload must not strand the clients
+        [t.join() for t in threads]
+        im.close()
+
+    assert not failures, failures[:5]
+    assert results
+    seen = set()
+    for out in results:
+        ratios = out / x
+        # entirely one version: a single scale across the whole result
+        assert np.allclose(ratios, ratios.flat[0]), ratios
+        s = float(ratios.flat[0])
+        assert any(np.isclose(s, c) for c in scales), s
+        seen.add(round(s))
+    assert len(seen) >= 2, seen  # traffic straddled at least one reload
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_coalescer_crash_fails_queued_and_inflight_not_hang():
+    """Dispatcher death between enqueue and pack must FAIL waiters, not
+    strand them: queued requests, dispatched-but-unresolved groups, and
+    later submits all get an exception promptly."""
+    gate, entered = threading.Event(), threading.Event()
+
+    def blocking_fn(x):
+        entered.set()
+        gate.wait(timeout=30)
+        return x
+
+    cache = BucketedExecutableCache(blocking_fn, max_batch=2)
+    c = RequestCoalescer(cache, max_wait_ms=1.0)
+    f1 = c.submit(np.ones((1, 2), np.float32))  # dispatcher blocks in fn
+    assert entered.wait(timeout=10)  # f1's group is mid-dispatch
+
+    # sabotage the NEXT gather (instance attr shadows the bound method),
+    # then queue two more requests behind the blocked dispatch
+    def bad_gather(*a, **k):
+        raise RuntimeError("injected dispatcher crash")
+
+    c._gather = bad_gather
+    f2 = c.submit(np.ones((1, 2), np.float32))
+    f3 = c.submit(np.ones((1, 2), np.float32))
+    gate.set()  # unblock the dispatch; next loop iteration crashes
+
+    for f in (f2, f3):
+        with pytest.raises(RuntimeError, match="injected"):
+            f.result(timeout=10)
+    # f1 was dispatched: either it resolved before the crash or the
+    # crash net failed it — it must not hang either way
+    try:
+        f1.result(timeout=10)
+    except RuntimeError:
+        pass
+    c._thread.join(timeout=10)
+    assert not c._thread.is_alive()
+    assert c.pending == 0  # flushed requests left the live count too
+    with pytest.raises(CoalescerClosedError):
+        c.submit(np.ones((1, 2), np.float32))
+
+
+def test_submit_after_dispatcher_exit_raises_not_hangs():
+    """A dispatcher that exited (here: a sentinel injected directly,
+    bypassing close()) leaves the coalescer refusing submits instead of
+    accepting work nobody will serve."""
+    from analytics_zoo_tpu.pipeline.inference import serving as serving_mod
+
+    cache = BucketedExecutableCache(lambda x: x, max_batch=4)
+    c = RequestCoalescer(cache, max_wait_ms=1.0)
+    c._q.put(serving_mod._SHUTDOWN)
+    c._thread.join(timeout=10)
+    assert not c._thread.is_alive()
+    assert c.closed  # even though close() never ran
+    with pytest.raises(CoalescerClosedError):
+        c.submit(np.ones((1, 2), np.float32))
+    c.close()  # still idempotent afterwards
 
 
 def test_coalescer_close_is_idempotent_and_fails_stragglers():

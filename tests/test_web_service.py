@@ -1,0 +1,130 @@
+"""Web-service sample, in process: the registry-backed control plane
+behind HTTP — the full --self-test (concurrent clients + hot-swap
+mid-traffic with zero failed requests), plus the structured error
+surface (404/429/504 with machine-readable JSON bodies)."""
+
+import importlib.util
+import json
+import os
+import threading
+from http.server import ThreadingHTTPServer
+from urllib.error import HTTPError
+from urllib.request import Request, urlopen
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def web_service_mod():
+    path = os.path.join(REPO, "apps", "web-service-sample",
+                        "web_service.py")
+    spec = importlib.util.spec_from_file_location("zoo_web_service", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _serve(mod, registry):
+    server = ThreadingHTTPServer(("127.0.0.1", 0),
+                                 mod.make_handler(registry))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, server.server_address[1]
+
+
+def _post(port, path, payload):
+    req = Request(f"http://127.0.0.1:{port}{path}",
+                  data=json.dumps(payload).encode(),
+                  headers={"Content-Type": "application/json"})
+    with urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_self_test_in_process_hot_swap_zero_failures(web_service_mod):
+    """The app's own --self-test, run in-process: 8 concurrent clients,
+    a hot-swap mid-traffic, zero failed requests, both versions
+    observed, /metrics coherent."""
+    mod = web_service_mod
+    registry = mod.build_registry()
+    server, port = _serve(mod, registry)
+    try:
+        mod.self_test(port)  # asserts internally
+    finally:
+        server.shutdown()
+        registry.shutdown()
+
+
+def test_structured_error_surface(web_service_mod):
+    mod = web_service_mod
+    from analytics_zoo_tpu.serving import ModelRegistry
+
+    registry = ModelRegistry(max_queue=2, max_concurrency=1)
+    registry.deploy(mod.DEFAULT_MODEL, mod.build_net(),
+                    warmup_shapes=(mod.N_FEATURES,))
+    server, port = _serve(mod, registry)
+    x = np.zeros((1, mod.N_FEATURES), np.float32).tolist()
+    try:
+        # unknown model -> 404 ModelNotFound, structured body
+        with pytest.raises(HTTPError) as ei:
+            _post(port, "/predict", {"instances": x, "model": "nope"})
+        assert ei.value.code == 404
+        body = json.loads(ei.value.read())
+        assert body["error"] == "ModelNotFound"
+        assert body["model"] == "nope"
+
+        # malformed payload -> 400 with the exception type
+        with pytest.raises(HTTPError) as ei:
+            _post(port, "/predict", {"wrong_key": x})
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["error"] == "KeyError"
+
+        # promote with no canary staged -> 404
+        with pytest.raises(HTTPError) as ei:
+            _post(port, "/promote", {"model": mod.DEFAULT_MODEL})
+        assert ei.value.code == 404
+
+        # a request that cannot meet its deadline -> 504, shed at
+        # admission (the EWMA seeded by a first successful call already
+        # exceeds a microsecond deadline)
+        _post(port, "/predict", {"instances": x})
+        with pytest.raises(HTTPError) as ei:
+            _post(port, "/predict",
+                  {"instances": x, "deadline_ms": 0.001})
+        assert ei.value.code == 504
+        body = json.loads(ei.value.read())
+        assert body["error"] == "DeadlineExceeded"
+        assert body["shed"] is True
+    finally:
+        server.shutdown()
+        registry.shutdown()
+
+
+def test_deploy_and_canary_over_http(web_service_mod):
+    mod = web_service_mod
+    registry = mod.build_registry()
+    server, port = _serve(mod, registry)
+    x = np.zeros((2, mod.N_FEATURES), np.float32).tolist()
+    try:
+        out = _post(port, "/predict", {"instances": x})
+        assert out["version"] == 1
+        # stage a canary at 50%, then promote it
+        dep = _post(port, "/deploy", {"model": mod.DEFAULT_MODEL,
+                                      "seed": 3, "canary_fraction": 0.5})
+        assert dep["version"] == 2
+        versions = {_post(port, "/predict",
+                          {"instances": x})["version"]
+                    for _ in range(8)}
+        assert versions == {1, 2}
+        prom = _post(port, "/promote", {"model": mod.DEFAULT_MODEL})
+        assert prom["version"] == 2
+        assert _post(port, "/predict", {"instances": x})["version"] == 2
+        with urlopen(f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            m = json.loads(r.read())[mod.DEFAULT_MODEL]
+        assert m["active_version"] == 2
+        assert m["swap_count"] == 1
+    finally:
+        server.shutdown()
+        registry.shutdown()
