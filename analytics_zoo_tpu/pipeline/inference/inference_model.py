@@ -288,9 +288,11 @@ class InferenceModel:
         batched, single, jtensor = self._normalize(inputs)
         if cache is None:
             # exact-shape path (bucketing off, or quantized handle whose
-            # batch-global activation scales forbid padding)
+            # batch-global activation scales forbid padding).  Explicit
+            # device_put for the same reason as the bucketed dispatch:
+            # the upload must be visible to transfer guards.
             with self._semaphore:
-                out = predict_fn(batched)
+                out = predict_fn(jax.device_put(batched))
             out = np.asarray(jax.device_get(out))
         else:
             out = None

@@ -148,6 +148,12 @@ class BucketedExecutableCache:
                     self.stats.misses.get(bucket, 0) + 1
             else:
                 self.stats.hits[bucket] = self.stats.hits.get(bucket, 0) + 1
+        # explicit upload: handing numpy straight to the jit is an
+        # IMPLICIT host->device transfer per dispatch — same bytes
+        # moved, but invisible to jax's transfer guards.  device_put
+        # keeps the hot loop clean under zoolint.sanitize() (and on a
+        # real TPU makes the per-dispatch upload an auditable event).
+        batched = jax.device_put(batched)
         if fresh:
             t0 = time.perf_counter()
             out = jax.block_until_ready(self._fn(batched))

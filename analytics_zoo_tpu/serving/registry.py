@@ -240,7 +240,11 @@ class ModelRegistry:
                         dep.state = "canary"
                         entry.canary = dep
                         entry.canary_fraction = float(canary_fraction)
-                        entry._canary_acc = 0.0
+                        # route_lock owns the accumulator (zoolint
+                        # ZL401): resetting it under entry.lock alone
+                        # races _route's += and loses the reset
+                        with entry.route_lock:
+                            entry._canary_acc = 0.0
                     else:
                         old = entry.active
                         dep.state = "active"
@@ -290,9 +294,12 @@ class ModelRegistry:
         executables), which can take up to the drain timeout."""
         if dep is None:
             return
-        dep.state = "retired"
         dep.model.close()
         with entry.lock:
+            # state flips under entry.lock like every other state write
+            # (zoolint ZL401); until the drain above finishes the
+            # deployment truthfully still reads as serving
+            dep.state = "retired"
             entry.retired.append(dep)
             del entry.retired[:-_RETIRED_KEPT]
 
@@ -363,8 +370,9 @@ class ModelRegistry:
                 deps = [d for d in (entry.active, entry.canary)
                         if d is not None]
                 entry.active = entry.canary = None
+                for d in deps:
+                    d.state = "retired"
         for d in deps:
-            d.state = "retired"
             d.model.close()
         return drained
 

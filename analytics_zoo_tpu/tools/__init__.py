@@ -1,0 +1,6 @@
+"""Developer tooling for the analytics_zoo_tpu codebase.
+
+Submodules import lazily; the zoolint static analyzer itself is
+pure-stdlib AST (only the runtime ``zoolint.sanitize`` half touches
+jax, and only when entered).
+"""

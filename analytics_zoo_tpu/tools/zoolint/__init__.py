@@ -1,0 +1,46 @@
+"""zoolint: JAX-aware static analyzer + runtime sanitizer.
+
+Static half (stdlib-only, no jax import):
+
+    from analytics_zoo_tpu.tools.zoolint import lint_paths
+    findings = lint_paths(["analytics_zoo_tpu"])
+
+Rule codes (catalog with rationale: docs/dev/zoolint.md):
+
+    ZL101/ZL102/ZL103  recompile hazards (jit-in-loop, jit-per-call,
+                       unhashable static argument)
+    ZL201/ZL202/ZL203  tracer leaks (host cast / Python branch / host
+                       materialization inside jit)
+    ZL301/ZL302        host sync on the serving hot path
+    ZL401/ZL402        lock discipline (mixed-lock writes, blocking
+                       device work under a lock)
+    ZL501/ZL502        thread lifecycle (unjoined non-daemon threads,
+                       unbounded queues)
+
+Runtime half (imports jax lazily, on first use):
+
+    with zoolint.sanitize(max_compiles=0):
+        hot_loop()
+"""
+
+from .baseline import (BaselineError, apply_baseline, load_baseline,
+                       render_baseline)
+from .engine import ALL_CODES, lint_paths
+from .findings import Finding
+from .hotpath import DEFAULT_HOT_ENTRIES
+
+__all__ = ["ALL_CODES", "BaselineError", "DEFAULT_HOT_ENTRIES",
+           "Finding", "RecompileDetected", "SanitizeError",
+           "SanitizeReport", "apply_baseline", "lint_paths",
+           "load_baseline", "render_baseline", "sanitize"]
+
+
+def __getattr__(name):
+    # sanitize + its error types live behind a lazy import so linting
+    # never drags jax into the process
+    if name in ("sanitize", "SanitizeError", "RecompileDetected",
+                "SanitizeReport"):
+        import importlib
+        mod = importlib.import_module(".sanitizer", __name__)
+        return getattr(mod, name)
+    raise AttributeError(name)

@@ -1,0 +1,80 @@
+"""zoolint command line.
+
+    python -m analytics_zoo_tpu.tools.zoolint PATH... [--baseline FILE]
+
+Exit codes: 0 clean (modulo baseline), 2 new findings, 3 the baseline
+file itself is broken (bad JSON / empty justification).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .baseline import (BaselineError, apply_baseline, load_baseline,
+                       render_baseline)
+from .engine import lint_paths
+from .hotpath import DEFAULT_HOT_ENTRIES
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="zoolint",
+        description="JAX-aware static analyzer for the serving/training "
+                    "stack (rule catalog: docs/dev/zoolint.md)")
+    ap.add_argument("paths", nargs="+", help="files or trees to lint")
+    ap.add_argument("--baseline", default=None,
+                    help="JSON baseline of accepted findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current findings as a baseline "
+                         "skeleton (empty justifications) and exit 0")
+    ap.add_argument("--root", default=None,
+                    help="root for relative finding paths (default: cwd)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--hot-entries", default=",".join(DEFAULT_HOT_ENTRIES),
+                    help="comma-separated final names treated as serving "
+                         "hot-path entry points (ZL301/ZL302)")
+    args = ap.parse_args(argv)
+
+    entries = tuple(e for e in args.hot_entries.split(",") if e)
+    findings = lint_paths(args.paths, root=args.root, hot_entries=entries)
+
+    if args.update_baseline:
+        target = args.baseline or "zoolint_baseline.json"
+        with open(target, "w", encoding="utf-8") as f:
+            f.write(render_baseline(findings))
+        print(f"zoolint: wrote {len(findings)} finding(s) to {target} — "
+              "fill in every justification before committing")
+        return 0
+
+    suppressed, stale = [], []
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except BaselineError as e:
+            print(f"zoolint: {e}", file=sys.stderr)
+            return 3
+        findings, suppressed, stale = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "suppressed": [vars(f) for f in suppressed],
+            "stale_suppressions": stale}, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        for e in stale:
+            print(f"zoolint: stale suppression (matches nothing): "
+                  f"{e['code']} {e['path']} {e['symbol']}",
+                  file=sys.stderr)
+        summary = (f"zoolint: {len(findings)} new finding(s), "
+                   f"{len(suppressed)} baselined, {len(stale)} stale")
+        print(summary, file=sys.stderr)
+    return 2 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,246 @@
+"""Shared AST infrastructure for zoolint rules.
+
+One parse per file; rules receive a :class:`ModuleContext` carrying the
+tree plus resolved import aliases (``jax``/``numpy``/``threading``/
+``queue`` under whatever names the module bound them), a dotted-name
+resolver, and a qualname-tracking walker base.  Everything here is
+stdlib-only — the static half of zoolint must never import jax.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+# attribute reads that are static under a jax trace (never materialize
+# a tracer) — branching or casting on these is fine inside jit
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+# calls whose result is static / host-side even with traced arguments
+STATIC_CALLS = {"len", "isinstance", "issubclass", "hasattr", "callable",
+                "getattr", "type", "id", "repr", "str", "format"}
+# lock-ish attribute names: `with recv.<attr>:` acquires a mutex.
+# Semaphores are deliberately NOT matched — they bound concurrency, they
+# don't own data.
+_LOCK_NAME_HINTS = ("lock", "cond", "mutex")
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_name(node: ast.AST) -> Optional[str]:
+    """The final component of a call target: ``x.y.predict`` -> "predict"."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_shallow(nodes: Sequence[ast.AST],
+                 skip=(ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.Lambda)) -> Iterator[ast.AST]:
+    """ast.walk over statements WITHOUT descending into nested function
+    bodies (their code runs later, not here).  Decorators and default
+    expressions of nested defs DO execute here, so they are yielded."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, skip):
+            for dec in getattr(node, "decorator_list", []):
+                stack.append(dec)
+            args = getattr(node, "args", None)
+            if isinstance(args, ast.arguments):
+                stack.extend(args.defaults)
+                stack.extend(d for d in args.kw_defaults if d is not None)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class ModuleContext:
+    """One parsed module + its import-alias table."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        # alias -> canonical module ("jax", "numpy", ...)
+        self.module_aliases: Dict[str, str] = {}
+        # local name -> canonical dotted name ("jit" -> "jax.jit")
+        self.name_aliases: Dict[str, str] = {}
+        self._scan_imports()
+
+    def _scan_imports(self):
+        canon = {"jax": "jax", "numpy": "numpy", "threading": "threading",
+                 "queue": "queue", "functools": "functools",
+                 "jax.numpy": "jax.numpy"}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in canon:
+                        self.module_aliases[a.asname or a.name] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if node.module in canon:
+                        self.name_aliases[a.asname or a.name] = \
+                            f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of an expression: ``j.jit`` -> "jax.jit"
+        when the module did ``import jax as j``; ``jit`` -> "jax.jit"
+        after ``from jax import jit``."""
+        name = dotted_name(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        if head in self.name_aliases:
+            base = self.name_aliases[head]
+            return f"{base}.{rest}" if rest else base
+        if head in self.module_aliases:
+            base = self.module_aliases[head]
+            return f"{base}.{rest}" if rest else base
+        return name
+
+    def is_jit_call(self, node: ast.AST) -> bool:
+        """Call node whose callee is jax.jit / jax.pmap (or an alias)."""
+        if not isinstance(node, ast.Call):
+            return False
+        return self.resolve(node.func) in ("jax.jit", "jax.pmap")
+
+
+class QualnameVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the enclosing ``Class.method`` qualname
+    and the stack of held locks (``with recv.some_lock:`` items)."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.class_stack: List[str] = []
+        self.func_stack: List[str] = []
+        # each entry: ("recv.attr") for every lock held at this point
+        self.lock_stack: List[str] = []
+
+    @property
+    def qualname(self) -> str:
+        parts = self.class_stack + self.func_stack
+        return ".".join(parts) if parts else "<module>"
+
+    # ---- scope tracking ----
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # ---- lock tracking ----
+    def _visit_with(self, node):
+        acquired = []
+        for item in node.items:
+            lock = lock_expr(item.context_expr)
+            if lock is not None:
+                acquired.append(lock)
+        self.lock_stack.extend(acquired)
+        self.generic_visit(node)
+        del self.lock_stack[len(self.lock_stack) - len(acquired):]
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+
+def lock_expr(expr: ast.AST) -> Optional[str]:
+    """"recv.attr" when a with-item context expression acquires a lock:
+    a bare attribute whose name smells like a mutex (``self._lock``,
+    ``entry.deploy_lock``, ``self._cond``).  Calls (``ac.admit()``) and
+    semaphores are not locks."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        attr = expr.attr.lower()
+        if any(h in attr for h in _LOCK_NAME_HINTS):
+            return f"{expr.value.id}.{expr.attr}"
+    return None
+
+
+def is_lock_ctor(ctx: ModuleContext, node: ast.AST) -> bool:
+    """``threading.Lock()`` / ``RLock()`` / ``Condition()``."""
+    if not isinstance(node, ast.Call):
+        return False
+    resolved = ctx.resolve(node.func)
+    if resolved is None:
+        return False
+    parts = resolved.split(".")
+    return parts[-1] in _LOCK_CTORS and (
+        len(parts) == 1 or parts[0] == "threading")
+
+
+def is_static_expr(node: ast.AST) -> bool:
+    """True when an expression is host-static even if its leaves are
+    traced: ``x.shape``, ``x.ndim == 2``, ``len(x)``,
+    ``isinstance(x, T)``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in STATIC_ATTRS
+    if isinstance(node, ast.Call):
+        fn = last_name(node.func)
+        return fn in STATIC_CALLS
+    return False
+
+
+def tainted_names(node: ast.AST, tainted: Set[str]) -> Set[str]:
+    """Names from ``tainted`` that appear in ``node`` OUTSIDE
+    static sub-expressions (shape/dtype reads, len() calls...)."""
+    found: Set[str] = set()
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if is_static_expr(n):
+            # descend only into the non-static parts (call args of
+            # len() etc. stay static; attribute bases stay static)
+            continue
+        if isinstance(n, ast.Name) and n.id in tainted:
+            found.add(n.id)
+        stack.extend(ast.iter_child_nodes(n))
+    return found
+
+
+def parse_static_spec(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """Literal static_argnums / static_argnames of a jax.jit call."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for c in _int_literals(kw.value):
+                nums.add(c)
+        elif kw.arg == "static_argnames":
+            for s in _str_literals(kw.value):
+                names.add(s)
+    return nums, names
+
+
+def _int_literals(node: ast.AST) -> Iterator[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            yield from _int_literals(e)
+
+
+def _str_literals(node: ast.AST) -> Iterator[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            yield from _str_literals(e)

@@ -1,0 +1,76 @@
+"""zoolint engine: walk files, parse once, run every rule.
+
+Module rules see one :class:`ModuleContext`; project rules (the
+call-graph hot-path pass) see all of them at once.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+from .context import ModuleContext
+from .findings import Finding
+from .hotpath import DEFAULT_HOT_ENTRIES, rule_hot_path
+from .rules_concurrency import (rule_blocking_under_lock,
+                                rule_lock_discipline,
+                                rule_thread_lifecycle,
+                                rule_unbounded_queue)
+from .rules_jax import rule_recompile, rule_tracer_leaks, \
+    rule_unhashable_static
+
+MODULE_RULES: Tuple[Callable[[ModuleContext], List[Finding]], ...] = (
+    rule_recompile,          # ZL101 ZL102
+    rule_unhashable_static,  # ZL103
+    rule_tracer_leaks,       # ZL201 ZL202 ZL203
+    rule_lock_discipline,    # ZL401
+    rule_blocking_under_lock,  # ZL402
+    rule_thread_lifecycle,   # ZL501
+    rule_unbounded_queue,    # ZL502
+)
+
+#: every rule code zoolint can emit (docs + fixture tests key off this)
+ALL_CODES = ("ZL101", "ZL102", "ZL103", "ZL201", "ZL202", "ZL203",
+             "ZL301", "ZL302", "ZL401", "ZL402", "ZL501", "ZL502")
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               hot_entries: Tuple[str, ...] = DEFAULT_HOT_ENTRIES
+               ) -> List[Finding]:
+    """Lint files/trees; paths in findings are relative to ``root``
+    (default: cwd) with forward slashes, so baselines are portable."""
+    root = os.path.abspath(root or os.getcwd())
+    ctxs: List[ModuleContext] = []
+    findings: List[Finding] = []
+    for fp in _iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(fp), root).replace(
+            os.sep, "/")
+        try:
+            with open(fp, "r", encoding="utf-8") as f:
+                src = f.read()
+            ctx = ModuleContext(rel, src)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                "ZL000", rel, getattr(e, "lineno", 1) or 1, 0, "<module>",
+                f"file does not parse: {e}"))
+            continue
+        ctxs.append(ctx)
+    for ctx in ctxs:
+        for rule in MODULE_RULES:
+            findings.extend(rule(ctx))
+    findings.extend(rule_hot_path(ctxs, hot_entries))
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings

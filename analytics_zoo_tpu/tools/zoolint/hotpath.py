@@ -1,0 +1,131 @@
+"""Hot-path host-sync rules (project-wide, call-graph based).
+
+  ZL301  ``block_until_ready`` reachable from a serving hot entry point —
+         a forced device sync on the request path serializes dispatch
+         against compute.
+  ZL302  implicit device→host materialization in a hot function:
+         np.asarray / np.array / float() wrapped DIRECTLY around a
+         dispatch call (``np.asarray(self._fn(x))``) — fetch explicitly
+         via jax.device_get so transfer guards (and readers) see it.
+
+The call graph is name-based and deliberately over-approximate: an edge
+``f -> g`` exists when f's body calls anything whose final name is g
+(``self._cache.run`` reaches every ``run`` in the package).  That
+over-approximation errs toward marking code hot, which is the right
+direction for a lint — the baseline absorbs the justified hits.
+
+Hot entry points are matched by FINAL name so the rule follows renames
+and new implementations: ``predict``, ``predict_ex``, ``_loop`` (the
+coalescer dispatcher), ``submit``, and ``dispatch_padded``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .context import ModuleContext, QualnameVisitor, last_name
+from .findings import Finding
+
+DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
+                       "dispatch_padded")
+# callees whose result is a device value mid-flight: materializing their
+# return implicitly is the ZL302 pattern
+_DISPATCHY = {"predict_fn", "dispatch_padded"}
+_MATERIALIZERS = {"numpy.asarray", "numpy.array"}
+
+
+def _is_dispatchy(name: str) -> bool:
+    return (name in _DISPATCHY or name.endswith("_fn")
+            or name.startswith("dispatch"))
+
+
+class _DefCollector(QualnameVisitor):
+    """(qualname -> {called final names}) for one module."""
+
+    def __init__(self, ctx: ModuleContext):
+        super().__init__(ctx)
+        self.defs: Dict[str, ast.AST] = {}
+
+    def _visit_func(self, node):
+        self.func_stack.append(node.name)
+        self.defs.setdefault(self.qualname, node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+def _callees(fd: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fd):
+        if isinstance(node, ast.Call):
+            name = last_name(node.func)
+            if name:
+                out.add(name)
+    return out
+
+
+def rule_hot_path(ctxs: List[ModuleContext],
+                  hot_entries: Tuple[str, ...] = DEFAULT_HOT_ENTRIES
+                  ) -> List[Finding]:
+    # 1. collect every def in the project, keyed by (path, qualname)
+    defs: Dict[Tuple[str, str], ast.AST] = {}
+    by_final: Dict[str, List[Tuple[str, str]]] = {}
+    ctx_of: Dict[str, ModuleContext] = {}
+    for ctx in ctxs:
+        ctx_of[ctx.path] = ctx
+        col = _DefCollector(ctx)
+        col.visit(ctx.tree)
+        for qual, fd in col.defs.items():
+            key = (ctx.path, qual)
+            defs[key] = fd
+            by_final.setdefault(qual.rsplit(".", 1)[-1], []).append(key)
+
+    # 2. BFS from the entry points over name-resolved call edges
+    hot: Set[Tuple[str, str]] = set()
+    frontier = [k for name in hot_entries for k in by_final.get(name, [])]
+    hot.update(frontier)
+    while frontier:
+        key = frontier.pop()
+        for callee in _callees(defs[key]):
+            for nxt in by_final.get(callee, []):
+                if nxt not in hot:
+                    hot.add(nxt)
+                    frontier.append(nxt)
+
+    # 3. flag sync / implicit-materialize sites inside hot defs
+    findings: List[Finding] = []
+    for (path, qual) in sorted(hot):
+        fd = defs[(path, qual)]
+        ctx = ctx_of[path]
+        for node in ast.walk(fd):
+            if not isinstance(node, ast.Call):
+                continue
+            if last_name(node.func) == "block_until_ready":
+                findings.append(Finding(
+                    "ZL301", path, node.lineno, node.col_offset, qual,
+                    "block_until_ready on the serving hot path "
+                    f"(reachable from {'/'.join(hot_entries)}): a forced "
+                    "device sync serializes dispatch against compute — "
+                    "fetch via jax.device_get at the fan-out point, or "
+                    "baseline with a justification if the sync is the "
+                    "point (e.g. compile-time measurement)"))
+                continue
+            resolved = ctx.resolve(node.func)
+            wraps_dispatch = (
+                node.args and isinstance(node.args[0], ast.Call)
+                and (lambda n: n is not None and _is_dispatchy(n))(
+                    last_name(node.args[0].func)))
+            if wraps_dispatch and (
+                    resolved in _MATERIALIZERS
+                    or (isinstance(node.func, ast.Name)
+                        and node.func.id == "float")):
+                findings.append(Finding(
+                    "ZL302", path, node.lineno, node.col_offset, qual,
+                    "implicit device->host materialization of a "
+                    "dispatch result on the hot path — wrap the fetch "
+                    "in jax.device_get (explicit transfers pass "
+                    "transfer guards; implicit ones abort them)"))
+    return findings

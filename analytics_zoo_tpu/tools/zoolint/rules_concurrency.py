@@ -1,0 +1,215 @@
+"""Concurrency static rules.
+
+Lock discipline
+  ZL401  an attribute written both with and without its owning lock: the
+         owning lock is the one held at the majority of write sites;
+         sites missing it are flagged.  ``__init__`` writes (construction
+         — no concurrent reader can exist yet) are exempt.
+  ZL402  blocking device work (warmup / block_until_ready / device_get /
+         fetch_rows / dispatch_padded / predict) performed while holding
+         a lock — every other thread contending that lock now waits on
+         the device.
+
+Thread lifecycle
+  ZL501  non-daemon thread that is never joined in its module: leaks at
+         interpreter exit and pins the process on crash.
+  ZL502  unbounded queue.Queue: under overload it converts memory into
+         latency instead of shedding (see serving.admission).
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+from typing import Dict, List, Optional, Set, Tuple
+
+from .context import (ModuleContext, QualnameVisitor, dotted_name,
+                      is_lock_ctor, last_name, lock_expr)
+from .findings import Finding
+
+_BLOCKING_DEVICE_CALLS = {"warmup", "block_until_ready", "device_get",
+                          "fetch_rows", "dispatch_padded", "predict",
+                          "predict_ex"}
+
+
+# ----------------------------------------------------------------- ZL401
+class _WriteSite:
+    __slots__ = ("line", "col", "symbol", "locks", "in_init")
+
+    def __init__(self, line, col, symbol, locks, in_init):
+        self.line, self.col, self.symbol = line, col, symbol
+        self.locks: Set[str] = locks
+        self.in_init = in_init
+
+
+class _LockDisciplineVisitor(QualnameVisitor):
+    def __init__(self, ctx: ModuleContext):
+        super().__init__(ctx)
+        # (recv_kind, attr) -> [write sites]; recv_kind is the class
+        # name for `self.x` writes and the bare variable name otherwise
+        self.writes: Dict[Tuple[str, str], List[_WriteSite]] = \
+            collections.defaultdict(list)
+        self.lock_attrs: Set[str] = set()
+
+    def _record(self, target: ast.AST):
+        if not (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)):
+            return
+        recv, attr = target.value.id, target.attr
+        if lock_expr(target) is not None:
+            return  # assigning the lock itself
+        if recv == "self":
+            kind = self.class_stack[-1] if self.class_stack else "self"
+        else:
+            kind = recv
+        in_init = bool(self.func_stack) and self.func_stack[0] == "__init__"
+        self.writes[(kind, attr)].append(_WriteSite(
+            target.lineno, target.col_offset, self.qualname,
+            set(self.lock_stack), in_init))
+
+    def visit_Assign(self, node: ast.Assign):
+        if is_lock_ctor(self.ctx, node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    self.lock_attrs.add(t.attr)
+        else:
+            for t in node.targets:
+                if isinstance(t, ast.Tuple):
+                    for e in t.elts:
+                        self._record(e)
+                else:
+                    self._record(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record(node.target)
+        self.generic_visit(node)
+
+
+def rule_lock_discipline(ctx: ModuleContext) -> List[Finding]:
+    v = _LockDisciplineVisitor(ctx)
+    v.visit(ctx.tree)
+    findings: List[Finding] = []
+    for (kind, attr), sites in sorted(v.writes.items()):
+        live = [s for s in sites if not s.in_init]
+        locked = [s for s in live if s.locks]
+        if not locked or len(live) < 2:
+            continue  # never locked (single-writer style) or single site
+        counts = collections.Counter(
+            lock for s in locked for lock in s.locks)
+        owner, _ = counts.most_common(1)[0]
+        offenders = [s for s in live if owner not in s.locks]
+        if not offenders:
+            continue
+        owned = sum(1 for s in live if owner in s.locks)
+        for s in offenders:
+            held = f"under {sorted(s.locks)}" if s.locks else "with no lock"
+            findings.append(Finding(
+                "ZL401", ctx.path, s.line, s.col, s.symbol,
+                f"attribute {kind}.{attr} is written {held} here but "
+                f"under {owner} at {owned} other site(s) — a "
+                "torn/lost update is one unlucky preemption away"))
+    return findings
+
+
+# ----------------------------------------------------------------- ZL402
+class _BlockingUnderLockVisitor(QualnameVisitor):
+    def __init__(self, ctx: ModuleContext):
+        super().__init__(ctx)
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node: ast.Call):
+        name = last_name(node.func)
+        if self.lock_stack and name in _BLOCKING_DEVICE_CALLS:
+            self.findings.append(Finding(
+                "ZL402", self.ctx.path, node.lineno, node.col_offset,
+                self.qualname,
+                f"blocking device call {name}() while holding "
+                f"{sorted(set(self.lock_stack))}: every thread "
+                "contending this lock now waits on device latency — "
+                "move the dispatch outside the critical section"))
+        self.generic_visit(node)
+
+
+def rule_blocking_under_lock(ctx: ModuleContext) -> List[Finding]:
+    v = _BlockingUnderLockVisitor(ctx)
+    v.visit(ctx.tree)
+    return v.findings
+
+
+# ----------------------------------------------------------------- ZL501
+def rule_thread_lifecycle(ctx: ModuleContext) -> List[Finding]:
+    findings: List[Finding] = []
+    # every `<something>.join(` receiver dotted path seen in the module
+    joined: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            recv = dotted_name(node.func.value)
+            if recv:
+                joined.add(recv)
+
+    class V(QualnameVisitor):
+        def visit_Call(self, node: ast.Call):
+            if self.ctx.resolve(node.func) in ("threading.Thread",
+                                               "Thread"):
+                daemon = any(
+                    kw.arg == "daemon"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in node.keywords)
+                if not daemon and not self._target_joined(node):
+                    findings.append(Finding(
+                        "ZL501", self.ctx.path, node.lineno,
+                        node.col_offset, self.qualname,
+                        "non-daemon Thread that is never joined in this "
+                        "module: it outlives its owner, pins interpreter "
+                        "exit, and strands work on crash — pass "
+                        "daemon=True or join it"))
+            self.generic_visit(node)
+
+        def _target_joined(self, call: ast.Call) -> bool:
+            parent = self._assign_target_of(call)
+            return parent is not None and parent in joined
+
+        def _assign_target_of(self, call: ast.Call) -> Optional[str]:
+            # the name/attr this Thread(...) was bound to, if any
+            for node in ast.walk(self.ctx.tree):
+                if isinstance(node, ast.Assign) and node.value is call:
+                    for t in node.targets:
+                        d = dotted_name(t)
+                        if d:
+                            return d
+            return None
+
+    V(ctx).visit(ctx.tree)
+    return findings
+
+
+# ----------------------------------------------------------------- ZL502
+def rule_unbounded_queue(ctx: ModuleContext) -> List[Finding]:
+    findings: List[Finding] = []
+
+    class V(QualnameVisitor):
+        def visit_Call(self, node: ast.Call):
+            resolved = self.ctx.resolve(node.func)
+            if resolved in ("queue.Queue", "queue.LifoQueue",
+                            "queue.PriorityQueue", "Queue"):
+                bounded = bool(node.args) or any(
+                    kw.arg == "maxsize" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value == 0)
+                    for kw in node.keywords)
+                if not bounded:
+                    findings.append(Finding(
+                        "ZL502", self.ctx.path, node.lineno,
+                        node.col_offset, self.qualname,
+                        "unbounded queue.Queue: under overload it "
+                        "converts memory into latency instead of "
+                        "shedding — pass maxsize (see "
+                        "serving.admission for the argument)"))
+            self.generic_visit(node)
+
+    V(ctx).visit(ctx.tree)
+    return findings

@@ -1,0 +1,233 @@
+"""JAX-specific static rules.
+
+Recompile hazards
+  ZL101  jax.jit / jax.pmap invoked inside a loop body — a fresh wrapper
+         (with a fresh trace cache) per iteration.
+  ZL102  immediately-invoked jit: ``jax.jit(f)(x)`` builds a new wrapper
+         per call, so every call re-traces.
+  ZL103  unhashable value (list/dict/set display) passed in a position
+         the jit declared static — TypeError at best, a compile per
+         call-site mutation at worst.
+
+Tracer leaks (inside jit-decorated scopes)
+  ZL201  float()/int()/bool() on a possibly-traced value.
+  ZL202  Python ``if``/``while`` branching on a possibly-traced value
+         (static .shape/.ndim/len() tests are exempt).
+  ZL203  host materialization of a possibly-traced value:
+         np.asarray/np.array, ``.item()``, ``.tolist()``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .context import (ModuleContext, QualnameVisitor, last_name,
+                      parse_static_spec, tainted_names, walk_shallow)
+from .findings import Finding
+
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_HOST_METHODS = {"item", "tolist"}
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+# --------------------------------------------------------- ZL101 / ZL102
+class _RecompileVisitor(QualnameVisitor):
+    def __init__(self, ctx: ModuleContext):
+        super().__init__(ctx)
+        self.findings: List[Finding] = []
+        self._reported: set = set()
+
+    def _visit_loop(self, node):
+        # flag jit calls lexically in the loop body — including nested
+        # defs' decorators (they run per iteration) but not nested defs'
+        # bodies (those run when called)
+        for child in walk_shallow(node.body + node.orelse):
+            if self.ctx.is_jit_call(child) and \
+                    id(child) not in self._reported:
+                self._reported.add(id(child))  # nested loops: report once
+                self.findings.append(Finding(
+                    "ZL101", self.ctx.path, child.lineno, child.col_offset,
+                    self.qualname,
+                    "jax.jit/pmap invoked inside a loop: each iteration "
+                    "builds a fresh wrapper with an empty trace cache — "
+                    "hoist the jit out and reuse it"))
+        self.generic_visit(node)
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_Call(self, node: ast.Call):
+        if self.ctx.is_jit_call(node.func):
+            self.findings.append(Finding(
+                "ZL102", self.ctx.path, node.lineno, node.col_offset,
+                self.qualname,
+                "immediately-invoked jit `jax.jit(f)(x)`: a new wrapper "
+                "per call means a re-trace per call — bind `g = "
+                "jax.jit(f)` once and call g"))
+        self.generic_visit(node)
+
+
+def rule_recompile(ctx: ModuleContext) -> List[Finding]:
+    v = _RecompileVisitor(ctx)
+    v.visit(ctx.tree)
+    # ZL101 sites also match ZL102's pattern only when immediately
+    # invoked; the visitor reports each pattern independently.
+    return v.findings
+
+
+# ----------------------------------------------------------------- ZL103
+def rule_unhashable_static(ctx: ModuleContext) -> List[Finding]:
+    """Track ``g = jax.jit(f, static_argnums=...)`` bindings, then flag
+    calls of ``g`` passing an unhashable display in a static position."""
+    findings: List[Finding] = []
+    static_of: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and ctx.is_jit_call(node.value)):
+            nums, names = parse_static_spec(node.value)
+            if nums or names:
+                static_of[node.targets[0].id] = (nums, names)
+
+    class V(QualnameVisitor):
+        def visit_Call(self, node: ast.Call):
+            name = node.func.id if isinstance(node.func, ast.Name) else None
+            spec = static_of.get(name)
+            if spec is not None:
+                nums, names = spec
+                for i, arg in enumerate(node.args):
+                    if i in nums and isinstance(arg, _UNHASHABLE):
+                        findings.append(Finding(
+                            "ZL103", ctx.path, arg.lineno, arg.col_offset,
+                            self.qualname,
+                            f"unhashable literal passed to {name}() in "
+                            f"static position {i}: static jit arguments "
+                            "must be hashable (use a tuple)"))
+                for kw in node.keywords:
+                    if kw.arg in names and isinstance(kw.value, _UNHASHABLE):
+                        findings.append(Finding(
+                            "ZL103", ctx.path, kw.value.lineno,
+                            kw.value.col_offset, self.qualname,
+                            f"unhashable literal passed to {name}() for "
+                            f"static argument {kw.arg!r} (use a tuple)"))
+            self.generic_visit(node)
+
+    V(ctx).visit(ctx.tree)
+    return findings
+
+
+# --------------------------------------------------- ZL201/ZL202/ZL203
+def _jitted_functions(ctx: ModuleContext):
+    """(funcdef, static_names) for every function jitted in this module:
+    decorated with @jax.jit / @partial(jax.jit, ...), or a named def
+    passed to jax.jit() somewhere in the module."""
+    jitted: Dict[ast.AST, Set[str]] = {}
+    by_name: Dict[str, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                spec = _jit_decorator_spec(ctx, dec)
+                if spec is not None:
+                    jitted[node] = _static_names_of(node, *spec)
+    for node in ast.walk(ctx.tree):
+        if ctx.is_jit_call(node):
+            target = node.args[0] if node.args else None
+            if isinstance(target, ast.Name) and target.id in by_name:
+                fd = by_name[target.id]
+                if fd not in jitted:
+                    nums, names = parse_static_spec(node)
+                    jitted[fd] = _static_names_of(fd, nums, names)
+    return jitted
+
+
+def _jit_decorator_spec(ctx: ModuleContext, dec: ast.AST
+                        ) -> Optional[Tuple[Set[int], Set[str]]]:
+    if ctx.resolve(dec) in ("jax.jit", "jax.pmap"):
+        return set(), set()
+    if isinstance(dec, ast.Call):
+        resolved = ctx.resolve(dec.func)
+        if resolved in ("jax.jit", "jax.pmap"):
+            return parse_static_spec(dec)
+        if resolved in ("functools.partial", "partial") and dec.args \
+                and ctx.resolve(dec.args[0]) in ("jax.jit", "jax.pmap"):
+            return parse_static_spec(dec)
+    return None
+
+
+def _static_names_of(fd, nums: Set[int], names: Set[str]) -> Set[str]:
+    params = [a.arg for a in fd.args.posonlyargs + fd.args.args]
+    static = set(names)
+    for i in nums:
+        if 0 <= i < len(params):
+            static.add(params[i])
+    return static
+
+
+def rule_tracer_leaks(ctx: ModuleContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for fd, static in _jitted_functions(ctx).items():
+        params = {a.arg for a in
+                  fd.args.posonlyargs + fd.args.args + fd.args.kwonlyargs}
+        tainted = params - static
+        if not tainted:
+            continue
+        # one cheap forward taint pass: names assigned from tainted exprs
+        for stmt in ast.walk(fd):
+            if isinstance(stmt, ast.Assign) and \
+                    tainted_names(stmt.value, tainted):
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+        qual = fd.name
+        for node in ast.walk(fd):
+            if isinstance(node, ast.Call):
+                fn = last_name(node.func)
+                if fn in _HOST_CASTS and isinstance(node.func, ast.Name) \
+                        and node.args and \
+                        tainted_names(node.args[0], tainted):
+                    findings.append(Finding(
+                        "ZL201", ctx.path, node.lineno, node.col_offset,
+                        qual,
+                        f"{fn}() on a traced value inside jit: raises "
+                        "TracerConversionError (or silently constant-"
+                        "folds) — use lax primitives or hoist out"))
+                elif fn in _HOST_METHODS and \
+                        isinstance(node.func, ast.Attribute) and \
+                        tainted_names(node.func.value, tainted):
+                    findings.append(Finding(
+                        "ZL203", ctx.path, node.lineno, node.col_offset,
+                        qual,
+                        f".{fn}() materializes a traced value to host "
+                        "inside jit"))
+                elif ctx.resolve(node.func) in (
+                        "numpy.asarray", "numpy.array") and node.args \
+                        and tainted_names(node.args[0], tainted):
+                    findings.append(Finding(
+                        "ZL203", ctx.path, node.lineno, node.col_offset,
+                        qual,
+                        "np.asarray/np.array on a traced value inside "
+                        "jit forces a host round-trip per trace — use "
+                        "jnp instead"))
+            elif isinstance(node, (ast.If, ast.While)):
+                hits = tainted_names(node.test, tainted)
+                if hits and not _is_identity_test(node.test):
+                    findings.append(Finding(
+                        "ZL202", ctx.path, node.lineno, node.col_offset,
+                        qual,
+                        f"Python branch on possibly-traced "
+                        f"{sorted(hits)} inside jit: tracers have no "
+                        "truth value — use lax.cond/jnp.where, or mark "
+                        "the argument static"))
+    return findings
+
+
+def _is_identity_test(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` never touches __bool__."""
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops))

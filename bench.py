@@ -1566,6 +1566,50 @@ def serving_bench(n_requests: int = 400, d_in: int = 64, d_hidden: int = 64,
                 _log(f"serving selfcheck FAIL: c={c} compiled a bucket "
                      f"more than once: {misses}")
                 ok = False
+        # ---- zoolint sanitizer: the warmed hot loop must be compile-
+        # and transfer-clean (implicit host<->device transfers abort the
+        # dispatch under the guard; any XLA compile fails the block).
+        # Runs over BOTH paths: coalesced (dispatcher thread — covered
+        # because the guard is process-global) and solo.
+        from analytics_zoo_tpu.tools.zoolint import (RecompileDetected,
+                                                     sanitize)
+        san = {"clean": False, "compiles": None, "error": None}
+        try:
+            with sanitize(max_compiles=0) as rep:
+                for k in range(32):
+                    coal_im.predict(requests[k % len(requests)])
+                    solo_im.predict(requests[k % len(requests)])
+                errs = []
+
+                def _san_worker(tid):
+                    try:
+                        for k in range(8):
+                            coal_im.predict(requests[(tid + k)
+                                                     % len(requests)])
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(repr(e))
+
+                ths = [threading.Thread(target=_san_worker, args=(i,))
+                       for i in range(4)]
+                [t.start() for t in ths]
+                [t.join() for t in ths]
+                if errs:
+                    raise RuntimeError(errs[0])
+            san.update(clean=True, compiles=rep.compiles)
+            _log("serving selfcheck: sanitize clean — 0 recompiles, "
+                 "0 implicit transfers on the warmed hot loop "
+                 "(transfer_guard=disallow)")
+        except RecompileDetected as e:
+            san["error"] = f"recompile: {e}"
+            _log(f"serving selfcheck FAIL: sanitize caught a recompile "
+                 f"in the warmed hot loop: {e}")
+            ok = False
+        except Exception as e:  # transfer-guard violations land here
+            san["error"] = f"{type(e).__name__}: {e}"
+            _log(f"serving selfcheck FAIL: sanitize violation in the "
+                 f"hot loop: {type(e).__name__}: {e}")
+            ok = False
+        results["sanitize"] = san
     coal_im.close()
     solo_im.close()
     # ---- control plane: hot-swap blip + shed rate (ISSUE 2) ----

@@ -11,3 +11,7 @@ cd "$(dirname "$0")/.."
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q --co \
     -p no:cacheprovider "$@" > /dev/null
 echo "collection OK"
+# zoolint rides the same fast gate: new static findings fail CI here,
+# seconds after a push, not minutes into the suite (we already cd'd to
+# the repo root above, so resolve lint.sh from there)
+scripts/lint.sh

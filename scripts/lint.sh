@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# zoolint gate: the JAX-aware static analyzer over the shipped package,
+# against the checked-in baseline of justified suppressions.
+#
+# Exit 0  = clean modulo zoolint_baseline.json
+# Exit 2  = NEW finding (fix it, or baseline it WITH a justification —
+#           see docs/dev/zoolint.md for the workflow)
+# Exit 3  = the baseline file itself is broken (bad JSON / empty
+#           justification)
+#
+# Pure AST — runs in seconds; importing the package pulls jax, so pin
+# the platform to cpu like every other CI gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+env JAX_PLATFORMS=cpu python -m analytics_zoo_tpu.tools.zoolint \
+    analytics_zoo_tpu --baseline zoolint_baseline.json "$@"
+echo "zoolint OK"

@@ -93,6 +93,17 @@ def rng():
     return jax.random.PRNGKey(0)
 
 
+@pytest.fixture
+def zoolint_sanitize():
+    """The zoolint runtime sanitizer: wrap a pinned hot loop and assert
+    zero unexpected XLA compiles + no implicit host<->device transfers
+    (docs/dev/zoolint.md §Sanitizer).  Guards are process-global while
+    the block runs, so don't use it around concurrent unrelated jax
+    work — fine under the sequential tier-1 runner."""
+    from analytics_zoo_tpu.tools.zoolint import sanitize
+    return sanitize
+
+
 @pytest.fixture(autouse=True)
 def _fresh_context():
     """Reset the process-wide NNContext between tests."""

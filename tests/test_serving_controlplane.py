@@ -178,6 +178,76 @@ def test_canary_split_exact_fraction_then_promote():
         assert reg.metrics("m")["m"]["swap_count"] == 1
 
 
+def test_canary_redeploy_resets_routing_accumulator():
+    """Pinned (ISSUE 3 / zoolint ZL401 fix): the canary routing
+    accumulator is owned by route_lock and reset under it on every
+    canary deploy — routing after a re-deploy restarts deterministically
+    from zero instead of inheriting the displaced canary's leftovers
+    (or losing the reset to a racing _route increment)."""
+    with ModelRegistry() as reg:
+        _deploy_const(reg, "m", 1.0, warmup_shapes=(2,))
+        _deploy_const(reg, "m", 2.0, canary_fraction=0.5)
+        x = np.zeros((1, 2), np.float32)
+        # acc: 0.5 (active), 1.0 -> fires (canary), 0.5 (active)
+        flags = [reg.predict_ex("m", x)[1]["canary"] for _ in range(3)]
+        assert flags == [False, True, False]
+        # a NEW canary mid-cycle: acc restarts at exactly zero
+        _deploy_const(reg, "m", 3.0, canary_fraction=0.5)
+        flags = [reg.predict_ex("m", x)[1]["canary"] for _ in range(4)]
+        assert flags == [False, True, False, True]
+
+
+def test_retired_state_flips_after_drain_metrics_stay_responsive():
+    """Pinned (ISSUE 3 / zoolint ZL401 fix): a displaced deployment's
+    state flips to 'retired' under entry.lock only AFTER its drain
+    (model.close()) completes — while draining it is truthfully not yet
+    retired — and metrics() stays responsive throughout a slow drain
+    (it takes entry.lock, never deploy_lock)."""
+    class SlowCloseModel:
+        def __init__(self):
+            self.close_entered = threading.Event()
+            self.closed = threading.Event()
+
+        def predict(self, x):
+            return np.asarray(x)
+
+        def close(self):
+            self.close_entered.set()
+            time.sleep(0.4)
+            self.closed.set()
+
+        def serving_stats(self):
+            return {}
+
+    slow = SlowCloseModel()
+    with ModelRegistry(max_concurrency=2) as reg:
+        reg.deploy("m", model=slow)
+        samples = []
+
+        def watcher():
+            slow.close_entered.wait(5)
+            while True:
+                m = reg.metrics("m")["m"]
+                drained = slow.closed.is_set()  # AFTER the read: sound
+                if drained:
+                    return
+                v1 = m["versions"].get(1)
+                samples.append(None if v1 is None else v1["state"])
+                time.sleep(0.02)
+
+        t = threading.Thread(target=watcher)
+        t.start()
+        fn, params = _const_fn(2.0)
+        reg.deploy("m", jax_fn=fn, params=params)  # displaces slow
+        t.join(10)
+        assert not t.is_alive()
+        # metrics were served DURING the 0.4s drain, and never showed
+        # the draining version as already-retired
+        assert len(samples) >= 3, samples
+        assert "retired" not in samples, samples
+        assert reg.metrics("m")["m"]["versions"][1]["state"] == "retired"
+
+
 def test_clear_canary_restores_all_traffic_to_active():
     with ModelRegistry() as reg:
         _deploy_const(reg, "m", 1.0, warmup_shapes=(2,))

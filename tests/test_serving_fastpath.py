@@ -409,6 +409,72 @@ def test_kerasnet_to_serving_convenience():
     assert im.serving_stats()["misses"] == stats["misses"]  # warm
 
 
+# --------------------------------------------------- runtime sanitizer
+def test_coalescer_hot_loop_is_sanitize_clean(zoolint_sanitize):
+    """Pinned (ISSUE 3): the coalescer hot loop — concurrent callers,
+    dispatcher thread, padded dispatch, fan-out — performs ZERO XLA
+    compiles and ZERO implicit transfers once warmed.  The dispatcher
+    runs in its own thread, which is exactly why sanitize() sets the
+    process-global guard: a thread-local guard would miss it."""
+    im = InferenceModel(supported_concurrent_num=2, max_batch_size=8,
+                        coalescing=True, max_wait_ms=2.0)
+    im.load_jax(lambda p, x: x @ p["w"], {"w": np.eye(4, dtype=np.float32)})
+    im.warmup((4,))
+    errors = []
+
+    def worker(i):
+        try:
+            im.predict(np.full((1 + i % 3, 4), float(i), np.float32))
+        except Exception as e:  # noqa: BLE001 — asserted empty below
+            errors.append(repr(e))
+
+    with zoolint_sanitize(max_compiles=0) as rep:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(12)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+    assert not errors, errors[:3]
+    assert rep.compiles == 0
+    im.close()
+
+
+def test_sanitize_catches_recompile_injected_into_hot_loop(
+        zoolint_sanitize):
+    """The negative control for the test above: a deliberately unwarmed
+    signature slipped into the same coalesced hot loop IS caught."""
+    from analytics_zoo_tpu.tools.zoolint import RecompileDetected
+    im = InferenceModel(supported_concurrent_num=2, max_batch_size=8,
+                        coalescing=True, max_wait_ms=2.0)
+    im.load_jax(lambda p, x: x * p["s"], {"s": np.float32(2.0)})
+    im.warmup((4,))
+    with pytest.raises(RecompileDetected):
+        with zoolint_sanitize(max_compiles=0, transfer_guard=None):
+            im.predict(np.ones((1, 4), np.float32))   # warm: clean
+            im.predict(np.ones((1, 6), np.float32))   # injected: new sig
+    im.close()
+
+
+def test_sanitize_catches_implicit_transfer_injected_into_dispatch(
+        zoolint_sanitize):
+    """If the bucketed dispatch ever regresses to handing raw numpy to
+    the jit (an implicit host->device transfer per dispatch — what
+    explicit device_put in _dispatch prevents), the sanitizer aborts
+    the dispatch and the caller sees the violation."""
+    im = InferenceModel(supported_concurrent_num=2, max_batch_size=8,
+                        coalescing=True, max_wait_ms=2.0)
+    im.load_jax(lambda p, x: x + p["b"], {"b": np.float32(1.0)})
+    im.warmup((4,))
+    fastpath_fn = im._fastpath[0]  # the jit the dispatch path wraps
+    with pytest.raises(Exception, match="Disallowed host-to-device"):
+        with zoolint_sanitize(max_compiles=0):
+            fastpath_fn(np.ones((2, 4), np.float32))  # bypass device_put
+    # ...while the REAL dispatch path stays clean under the same guard
+    with zoolint_sanitize(max_compiles=0):
+        out = im.predict(np.ones((2, 4), np.float32))
+    np.testing.assert_array_equal(out, np.full((2, 4), 2.0, np.float32))
+    im.close()
+
+
 # --------------------------------------------------- quantized handles
 def test_quantized_handle_skips_padding():
     """int8 activation scales are batch-global — padded filler rows

@@ -1,0 +1,9 @@
+import jax
+
+
+def serve(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)  # a fresh wrapper per iteration
+        outs.append(f(x))
+    return outs

@@ -1,0 +1,5 @@
+import jax
+
+
+def step(x):
+    return jax.jit(lambda v: v + 1)(x)  # re-traces on every call

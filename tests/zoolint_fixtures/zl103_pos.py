@@ -1,0 +1,12 @@
+import jax
+
+
+def f(x, dims):
+    return x.sum(dims)
+
+
+g = jax.jit(f, static_argnums=(1,))
+
+
+def reduce_last_two(x):
+    return g(x, [0, 1])  # unhashable static: TypeError / compile churn

@@ -1,0 +1,7 @@
+import jax
+
+
+@jax.jit
+def normalize(x):
+    scale = float(x)  # TracerConversionError at trace time
+    return x / scale

@@ -1,0 +1,17 @@
+import functools
+
+import jax
+
+
+@jax.jit
+def maybe_expand(x):
+    if x.ndim == 1:  # rank is static — this branch resolves at trace
+        return x[None, :]
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scale(x, training):
+    if training:  # static argument: concrete at trace time
+        return x * 2
+    return x

@@ -1,0 +1,7 @@
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def to_device_dtype(x):
+    return jnp.asarray(x, jnp.float32)  # stays on device

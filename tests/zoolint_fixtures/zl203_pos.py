@@ -1,0 +1,8 @@
+import numpy as np
+
+import jax
+
+
+@jax.jit
+def to_host(x):
+    return np.asarray(x)  # host round-trip inside the trace

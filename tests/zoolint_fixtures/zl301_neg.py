@@ -1,0 +1,5 @@
+import jax
+
+
+def predict(fn, x):
+    return jax.device_get(fn(x))  # explicit fetch, no standalone sync

@@ -1,0 +1,7 @@
+import numpy as np
+
+import jax
+
+
+def predict(model, x):
+    return np.asarray(jax.device_get(model.predict_fn(x)))  # explicit

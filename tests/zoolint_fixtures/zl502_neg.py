@@ -1,0 +1,3 @@
+import queue
+
+requests: "queue.Queue" = queue.Queue(maxsize=64)  # bounded: sheds
