@@ -329,8 +329,8 @@ class StreamingDataset(Dataset):
                 return
             if not StreamingDataset._warned_no_shuffle:
                 StreamingDataset._warned_no_shuffle = True
-                import logging
-                logging.getLogger("analytics_zoo_tpu").warning(
+                from ..observability.log import get_logger
+                get_logger("analytics_zoo_tpu.data").warning(
                     "this stream source cannot shuffle and has "
                     "shuffle_buffer=None — every epoch replays the "
                     "source order. Shuffle at the source or pass a "

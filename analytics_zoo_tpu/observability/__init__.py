@@ -1,0 +1,34 @@
+"""End-to-end observability for the serving stack.
+
+Three halves, importable with zero jax cost (jax loads lazily inside
+``profile.install()`` only):
+
+* :mod:`.trace` — per-request :class:`Span`/:class:`Tracer` with
+  explicit cross-thread handoff through the coalescer, a bounded ring
+  buffer of recent traces, and per-phase aggregation;
+* :mod:`.metrics` — the unified :class:`MetricsRegistry` (labeled
+  counters/gauges, re-homed ``LatencyWindow``/``Counters``) with
+  Prometheus text exposition and the stdlib round-trip parser;
+* :mod:`.profile` — XLA hooks turning ``backend_compile`` events,
+  explicit transfers, and live-buffer counts into metrics/span events.
+
+Plus :mod:`.log` — the structured JSON logger with request-id
+correlation (the ZL601-sanctioned replacement for ``print``/stdlib
+``logging`` on hot paths).
+
+See docs/observability.md for the span taxonomy and wiring examples.
+"""
+
+from . import profile, trace
+from .log import StructuredLogger, get_logger
+from .metrics import (Counters, Family, LatencyWindow, MetricsRegistry,
+                      parse_prometheus_text, render_prometheus,
+                      summary_family)
+from .trace import PHASES, Span, Tracer, activate, current_span
+
+__all__ = [
+    "Counters", "Family", "LatencyWindow", "MetricsRegistry", "PHASES",
+    "Span", "StructuredLogger", "Tracer", "activate", "current_span",
+    "get_logger", "parse_prometheus_text", "profile",
+    "render_prometheus", "summary_family", "trace",
+]

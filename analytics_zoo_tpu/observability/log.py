@@ -1,0 +1,87 @@
+"""Structured logging with request correlation — the sanctioned way to
+log from serving-hot code (zoolint ZL601 flags bare ``print`` / stdlib
+``logging`` calls there).
+
+Why not plain ``logging``: a free-text line from the middle of the
+dispatch path cannot be joined back to the request that produced it.
+Records here are single-line JSON with a stable field set —
+``ts``/``level``/``logger``/``msg`` plus caller fields — and the
+current request's ``request_id`` (span trace id) attached
+automatically, so one ``grep request_id`` yields the request's full
+story across threads.
+
+Delivery still goes through the stdlib root machinery (one
+``logging.Logger`` per name underneath), so existing handler/level
+configuration keeps working::
+
+    from analytics_zoo_tpu.observability.log import get_logger
+    slog = get_logger("zoo.serving")
+    slog.info("dispatch", bucket=8, rows=5)
+    # {"ts": ..., "level": "info", "logger": "zoo.serving",
+    #  "msg": "dispatch", "request_id": "4f0c...", "bucket": 8, "rows": 5}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any, Dict, Optional
+
+from . import trace
+
+_LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
+           "warning": logging.WARNING, "error": logging.ERROR,
+           "critical": logging.CRITICAL}
+
+
+class StructuredLogger:
+    """JSON-lines logger bound to one name; see module docstring."""
+
+    __slots__ = ("name", "_logger")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._logger = logging.getLogger(name)
+
+    def _emit(self, level: str, msg: str, fields: Dict[str, Any]):
+        lvl = _LEVELS[level]
+        if not self._logger.isEnabledFor(lvl):
+            return
+        record: Dict[str, Any] = {
+            "ts": round(time.time(), 6), "level": level,
+            "logger": self.name, "msg": msg}
+        span = trace.current_span()
+        if span is not None:
+            record["request_id"] = span.trace_id
+        record.update(fields)
+        self._logger.log(lvl, "%s",
+                         json.dumps(record, default=str,
+                                    separators=(",", ":")))
+
+    def debug(self, msg: str, **fields: Any):
+        self._emit("debug", msg, fields)
+
+    def info(self, msg: str, **fields: Any):
+        self._emit("info", msg, fields)
+
+    def warning(self, msg: str, **fields: Any):
+        self._emit("warning", msg, fields)
+
+    def error(self, msg: str, **fields: Any):
+        self._emit("error", msg, fields)
+
+    def critical(self, msg: str, **fields: Any):
+        self._emit("critical", msg, fields)
+
+
+_loggers: Dict[str, StructuredLogger] = {}
+
+
+def get_logger(name: Optional[str] = None) -> StructuredLogger:
+    """The structured logger for ``name`` (cached per name)."""
+    key = name or "analytics_zoo_tpu"
+    slog = _loggers.get(key)
+    if slog is None:
+        slog = _loggers.setdefault(key, StructuredLogger(key))
+    return slog

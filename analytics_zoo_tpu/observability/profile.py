@@ -1,0 +1,174 @@
+"""XLA profiling hooks: compiles, transfers, and live buffers as
+metrics + span events instead of sanitizer aborts.
+
+zoolint's ``sanitize()`` turns an unexpected compile or implicit
+transfer into a hard failure — right for CI, wrong for production,
+where the question is "how often and where".  This module subscribes
+the SAME jax monitoring stream (``backend_compile`` duration events
+fire exactly once per real XLA compile; cache hits fire nothing, so
+counts are exact) but records instead of raising:
+
+* every compile increments ``zoo_xla_compiles_total`` / adds to
+  ``zoo_xla_compile_seconds_total`` AND lands as a ``backend_compile``
+  event on the current request span (when one is active via
+  ``trace.activate`` — e.g. an unwarmed shape compiling on the request
+  path shows up IN that request's trace);
+* other jax duration events count under
+  ``zoo_xla_events_total{event=...}`` (bounded cardinality: jax's own
+  event vocabulary);
+* the serving dispatch path reports its explicit uploads through
+  :func:`note_transfer` (``zoo_transfers_total{direction=...}``) — one
+  flag-check when no hooks are installed;
+* ``zoo_live_buffers`` is a scrape-time gauge over
+  ``jax.live_arrays()`` — a leak shows as monotonic growth.
+
+Install once per process (the web service does), plug
+``handle.families`` into a :class:`~.metrics.MetricsRegistry`::
+
+    handle = profile.install()
+    registry.register_collector(handle.families)
+    ...
+    handle.close()
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from . import trace
+from .metrics import Family
+
+_COMPILE_EVENT_SUBSTR = "backend_compile"
+
+_lock = threading.Lock()
+_installed: "Optional[XlaProfile]" = None
+
+
+class XlaProfile:
+    """Counters fed by jax's monitoring stream (see module doc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self._events: Dict[str, int] = {}
+        self._transfers: Dict[str, int] = {}
+        self._closed = False
+
+    # ---- feed side ----
+    def _on_duration_event(self, key: str, duration: float, **kw):
+        if self._closed:
+            return
+        if _COMPILE_EVENT_SUBSTR in key:
+            with self._lock:
+                self.compiles += 1
+                self.compile_seconds += duration
+            span = trace.current_span()
+            if span is not None:
+                span.event("backend_compile",
+                           seconds=round(duration, 6), key=key)
+        else:
+            with self._lock:
+                self._events[key] = self._events.get(key, 0) + 1
+
+    def _note_transfer(self, direction: str):
+        with self._lock:
+            self._transfers[direction] = \
+                self._transfers.get(direction, 0) + 1
+
+    # ---- read side ----
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"compiles": self.compiles,
+                    "compile_seconds": round(self.compile_seconds, 6),
+                    "events": dict(self._events),
+                    "transfers": dict(self._transfers)}
+
+    def families(self) -> List[Family]:
+        """Prometheus collector (plug into MetricsRegistry)."""
+        with self._lock:
+            compiles = self.compiles
+            seconds = self.compile_seconds
+            events = dict(self._events)
+            transfers = dict(self._transfers)
+        fams = [
+            Family("counter", "zoo_xla_compiles_total",
+                   "XLA backend compiles observed since install",
+                   [({}, compiles)]),
+            Family("counter", "zoo_xla_compile_seconds_total",
+                   "cumulative XLA compile wall seconds",
+                   [({}, seconds)]),
+        ]
+        if events:
+            fams.append(Family(
+                "counter", "zoo_xla_events_total",
+                "other jax monitoring duration events, by key",
+                [({"event": k}, v) for k, v in sorted(events.items())]))
+        if transfers:
+            fams.append(Family(
+                "counter", "zoo_transfers_total",
+                "explicit host<->device transfers on the serving "
+                "dispatch path, by direction",
+                [({"direction": d}, v)
+                 for d, v in sorted(transfers.items())]))
+        fams.append(Family(
+            "gauge", "zoo_live_buffers",
+            "live jax device buffers (scrape-time)",
+            [({}, _live_buffer_count())]))
+        return fams
+
+    def close(self):
+        """Unhook from jax monitoring (idempotent)."""
+        global _installed
+        self._closed = True
+        with _lock:
+            if _installed is self:
+                _installed = None
+        try:
+            from jax._src import monitoring as _monitoring
+            unhook = getattr(
+                _monitoring,
+                "_unregister_event_duration_listener_by_callback", None)
+            if unhook is not None:
+                unhook(self._on_duration_event)
+        except Exception:
+            pass  # _closed already made the listener inert
+
+
+def _live_buffer_count() -> float:
+    try:
+        import jax
+        return float(len(jax.live_arrays()))
+    except Exception:
+        return float("nan")
+
+
+def install() -> XlaProfile:
+    """Subscribe an :class:`XlaProfile` to jax's monitoring stream and
+    make it the process target for :func:`note_transfer`.  Returns the
+    existing handle when one is already installed (one stream, one
+    consumer)."""
+    global _installed
+    with _lock:
+        if _installed is not None:
+            return _installed
+        handle = XlaProfile()
+        from jax._src import monitoring as _monitoring
+        _monitoring.register_event_duration_secs_listener(
+            handle._on_duration_event)
+        _installed = handle
+        return handle
+
+
+def installed() -> "Optional[XlaProfile]":
+    return _installed
+
+
+def note_transfer(direction: str = "h2d"):
+    """Count one explicit transfer (called by the serving dispatch
+    path around its ``device_put``).  A single flag-check when no
+    profile is installed."""
+    handle = _installed
+    if handle is not None:
+        handle._note_transfer(direction)

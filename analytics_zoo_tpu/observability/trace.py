@@ -1,0 +1,327 @@
+"""Per-request tracing for the serving stack: Span / Tracer with
+explicit cross-thread handoff.
+
+A request through the serving plane hops five queues/threads (admission
+-> coalescer queue -> dispatcher -> device -> fan-out), so a p99
+regression is unattributable from endpoint latency alone.  Each request
+carries ONE :class:`Span` recording a contiguous sequence of phases::
+
+    admission_queue -> coalesce_wait -> pad -> device_put -> execute
+                    -> depad
+
+``phase_start`` closes the previously open phase at the same timestamp,
+so phases are gap-free BY CONSTRUCTION — the only uncovered time is the
+tail between the last ``phase_end`` and ``finish()`` (future wake-up +
+response serialization), which ``coverage`` exposes.
+
+Cross-thread handoff is EXPLICIT: contextvars do not propagate into the
+coalescer's dispatcher thread (it was started long before the request
+existed), so the pending request object carries its span and the
+dispatcher calls ``phase_start`` on it directly.  A span is only ever
+touched by one thread at a time (caller until submit, dispatcher until
+the future resolves, caller again after), so spans need no lock.
+
+Cost model: when no tracer is active, the hot path pays ONE module-flag
+branch (``current_span()`` returns None immediately); instrumentation
+sites guard every other call behind ``if span is not None``.
+
+Finished spans land in the tracer's bounded ring buffer (``recent()``)
+and their per-phase durations aggregate into ``phase_stats()`` /
+``families()`` for Prometheus exposition.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .metrics import Family
+
+#: the canonical request phase order (docs/observability.md)
+PHASES = ("admission_queue", "coalesce_wait", "pad", "device_put",
+          "execute", "depad")
+
+_SPAN_VAR: "contextvars.ContextVar[Optional[Span]]" = \
+    contextvars.ContextVar("zoo_tpu_span", default=None)
+# STICKY enable flag: False until the first span is ever activated in
+# this process, True forever after.  A process that never traces pays
+# exactly one bool branch per predict; once tracing has happened the
+# branch falls through to a contextvar read (~100ns).  Sticky (rather
+# than refcounted) keeps activate() lock-free on the request path —
+# the bench overhead gate measures this.
+_ENABLED = False
+
+
+def tracing_active() -> bool:
+    """True once any span has ever been activated in this process
+    (sticky — see the flag comment above)."""
+    return _ENABLED
+
+
+def current_span() -> "Optional[Span]":
+    """The span activated on this thread's context, or None.  Before
+    any tracing has happened the path is one global-flag branch — no
+    contextvar read."""
+    if not _ENABLED:
+        return None
+    return _SPAN_VAR.get()
+
+
+@contextlib.contextmanager
+def activate(span: "Optional[Span]"):
+    """Make ``span`` the current span for the calling thread (and any
+    code it calls synchronously).  Thread hops do NOT inherit it — hand
+    the span object across explicitly (the coalescer's pending request
+    carries it)."""
+    global _ENABLED
+    if span is None:
+        yield None
+        return
+    token = _SPAN_VAR.set(span)
+    if not _ENABLED:
+        _ENABLED = True
+    try:
+        yield span
+    finally:
+        _SPAN_VAR.reset(token)
+
+
+# a fresh uuid4 per request costs ~40us on small hosts — material
+# against a ~1ms request (the bench overhead gate caught it).  One
+# random prefix per process + a GIL-atomic counter is unique within
+# any ring/log scope and ~1us.
+_ID_PREFIX = uuid.uuid4().hex[:8]
+_ID_COUNTER = itertools.count(1)
+
+
+def new_trace_id() -> str:
+    return f"{_ID_PREFIX}{next(_ID_COUNTER) & 0xffffffff:08x}"
+
+
+class Span:
+    """One request's timeline: ordered phases + point events + labels.
+
+    Single-owner-at-a-time by design (see module doc) — no lock."""
+
+    __slots__ = ("name", "trace_id", "labels", "start_s", "start_wall",
+                 "end_s", "phases", "events", "_open", "_tracer")
+
+    def __init__(self, tracer: "Optional[Tracer]", name: str,
+                 trace_id: Optional[str] = None,
+                 labels: Optional[Dict[str, Any]] = None):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id or new_trace_id()
+        # taken by reference, not copied: every caller passes a fresh
+        # **labels dict, and the copy showed up in the overhead gate
+        self.labels: Dict[str, Any] = labels if labels is not None else {}
+        self.start_s = time.perf_counter()
+        self.start_wall = time.time()
+        self.end_s: Optional[float] = None
+        # each entry: [phase_name, start, end_or_None]
+        self.phases: List[List[Any]] = []
+        self.events: List[Dict[str, Any]] = []
+        self._open: Optional[List[Any]] = None
+
+    # ---- phases ----
+    def phase_start(self, name: str):
+        """Open phase ``name``; the previously open phase (if any) is
+        closed at the SAME timestamp, so consecutive phases never gap."""
+        t = time.perf_counter()
+        if self._open is not None:
+            self._open[2] = t
+        p = [name, t, None]
+        self.phases.append(p)
+        self._open = p
+
+    def phase_end(self):
+        """Close the open phase (idempotent when none is open)."""
+        if self._open is not None:
+            self._open[2] = time.perf_counter()
+            self._open = None
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        self.phase_start(name)
+        try:
+            yield self
+        finally:
+            self.phase_end()
+
+    # ---- events / labels ----
+    def event(self, name: str, **attrs: Any):
+        """A point-in-time annotation (e.g. an XLA ``backend_compile``
+        observed while this span was current)."""
+        self.events.append({"name": name,
+                            "t_s": time.perf_counter() - self.start_s,
+                            **attrs})
+
+    def set_label(self, key: str, value: Any):
+        self.labels[key] = value
+
+    # ---- lifecycle ----
+    def finish(self):
+        """Close the open phase, stamp the end, and hand the span to
+        its tracer's ring buffer / aggregates (idempotent)."""
+        if self.end_s is not None:
+            return
+        self.phase_end()
+        self.end_s = time.perf_counter()
+        if self._tracer is not None:
+            self._tracer._finished(self)
+
+    # ---- derived ----
+    @property
+    def wall_s(self) -> float:
+        end = self.end_s if self.end_s is not None else time.perf_counter()
+        return end - self.start_s
+
+    def phase_totals(self) -> Dict[str, float]:
+        """Total seconds per phase name (a phase may recur, e.g. pad /
+        execute once per chunk of an oversized batch)."""
+        out: Dict[str, float] = {}
+        for name, t0, t1 in self.phases:
+            if t1 is None:
+                continue
+            out[name] = out.get(name, 0.0) + (t1 - t0)
+        return out
+
+    @property
+    def phase_total_s(self) -> float:
+        return sum(self.phase_totals().values())
+
+    @property
+    def coverage(self) -> float:
+        """Fraction of the span wall time covered by phases — the
+        acceptance gate for "no phase gaps" (phases are internally
+        contiguous, so 1 - coverage is exactly the head + tail slack)."""
+        wall = self.wall_s
+        return (self.phase_total_s / wall) if wall > 0 else 1.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "trace_id": self.trace_id,
+            "name": self.name,
+            "labels": dict(self.labels),
+            "start_unix_s": round(self.start_wall, 6),
+            "wall_ms": round(self.wall_s * 1e3, 4),
+            "phases": [{"name": n,
+                        "start_ms": round((t0 - self.start_s) * 1e3, 4),
+                        "dur_ms": (None if t1 is None
+                                   else round((t1 - t0) * 1e3, 4))}
+                       for n, t0, t1 in self.phases],
+            "phase_total_ms": round(self.phase_total_s * 1e3, 4),
+            "coverage": round(self.coverage, 4),
+            "events": list(self.events),
+        }
+
+
+class Tracer:
+    """Span factory + bounded ring buffer of recent finished spans +
+    per-phase duration aggregation.
+
+    One tracer per serving process is the expected shape; the registry
+    and the web frontend share it.  ``capacity`` bounds memory: the ring
+    holds the most recent N finished spans, aggregates are O(#phases).
+    """
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = int(capacity)
+        self._ring: "deque[Span]" = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        # phase -> [count, total_s, max_s]
+        self._agg: Dict[str, List[float]] = {}
+        self._span_count = 0
+
+    def start_span(self, name: str = "request",
+                   trace_id: Optional[str] = None,
+                   **labels: Any) -> Span:
+        return Span(self, name, trace_id=trace_id, labels=labels)
+
+    @contextlib.contextmanager
+    def request(self, name: str = "request",
+                trace_id: Optional[str] = None, **labels: Any):
+        """Start a span, activate it for the calling thread, finish it
+        on exit — the one-liner for benches and tests.  Activation is
+        inlined (no nested context manager): this wrapper sits inside
+        the overhead the bench gate bounds."""
+        global _ENABLED
+        span = Span(self, name, trace_id=trace_id, labels=labels)
+        token = _SPAN_VAR.set(span)
+        if not _ENABLED:
+            _ENABLED = True
+        try:
+            yield span
+        finally:
+            _SPAN_VAR.reset(token)
+            span.finish()
+
+    def _finished(self, span: Span):
+        with self._lock:
+            self._ring.append(span)
+            self._span_count += 1
+            for phase, dur in span.phase_totals().items():
+                agg = self._agg.get(phase)
+                if agg is None:
+                    self._agg[phase] = [1, dur, dur]
+                else:
+                    agg[0] += 1
+                    agg[1] += dur
+                    agg[2] = max(agg[2], dur)
+
+    # ---- read side ----
+    @property
+    def span_count(self) -> int:
+        with self._lock:
+            return self._span_count
+
+    def recent(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        """The most recent ``n`` finished spans (all when None),
+        oldest first, as dicts.  ``n <= 0`` returns [] — slicing with
+        ``-0`` would silently mean "everything", and this is reachable
+        straight from ``GET /traces?n=``."""
+        with self._lock:
+            spans = list(self._ring)
+        if n is not None:
+            spans = spans[-n:] if n > 0 else []
+        return [s.to_dict() for s in spans]
+
+    def find(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            for s in reversed(self._ring):
+                if s.trace_id == trace_id:
+                    return s.to_dict()
+        return None
+
+    def phase_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-phase duration aggregation over every finished span."""
+        with self._lock:
+            return {phase: {"count": int(c),
+                            "total_s": round(total, 6),
+                            "mean_ms": round(total / c * 1e3, 4),
+                            "max_ms": round(mx * 1e3, 4)}
+                    for phase, (c, total, mx) in sorted(self._agg.items())}
+
+    def families(self) -> List[Family]:
+        """Prometheus collector (plug into MetricsRegistry)."""
+        with self._lock:
+            agg = {k: list(v) for k, v in self._agg.items()}
+            count = self._span_count
+        fams = [Family("counter", "zoo_trace_spans_total",
+                       "finished request spans",
+                       [({}, count)])]
+        fams.append(Family(
+            "counter", "zoo_trace_phase_seconds_total",
+            "cumulative seconds spent per request phase",
+            [({"phase": p}, v[1]) for p, v in sorted(agg.items())]))
+        fams.append(Family(
+            "counter", "zoo_trace_phase_count_total",
+            "phase occurrences across finished spans",
+            [({"phase": p}, v[0]) for p, v in sorted(agg.items())]))
+        return fams

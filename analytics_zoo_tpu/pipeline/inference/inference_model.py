@@ -20,6 +20,8 @@ from typing import Any, List, Optional, Sequence
 import numpy as np
 import jax
 
+from ...observability import profile as _profile
+from ...observability import trace as _trace
 from .serving import (BucketedExecutableCache, CoalescerClosedError,
                       RequestCoalescer, _rows)
 
@@ -285,6 +287,10 @@ class InferenceModel:
         if fastpath is None:
             raise RuntimeError("InferenceModel: no model loaded")
         predict_fn, cache, coalescer = fastpath
+        # the whole tracing cost when disabled is this one branch
+        # (current_span checks a module flag before touching the
+        # contextvar); every phase call below guards on span is None
+        span = _trace.current_span()
         batched, single, jtensor = self._normalize(inputs)
         if cache is None:
             # exact-shape path (bucketing off, or quantized handle whose
@@ -292,20 +298,31 @@ class InferenceModel:
             # device_put for the same reason as the bucketed dispatch:
             # the upload must be visible to transfer guards.
             with self._semaphore:
-                out = predict_fn(jax.device_put(batched))
+                if span is not None:
+                    span.phase_start("device_put")
+                xb = jax.device_put(batched)
+                _profile.note_transfer("h2d")
+                if span is not None:
+                    span.phase_start("execute")
+                out = predict_fn(xb)
             out = np.asarray(jax.device_get(out))
+            _profile.note_transfer("d2h")
+            if span is not None:
+                span.phase_end()
         else:
             out = None
             if (coalescer is not None and not coalescer.closed
                     and _rows(batched) <= cache.max_batch):
                 try:
-                    out = np.asarray(coalescer.submit(batched).result())
+                    out = np.asarray(
+                        coalescer.submit(batched, span=span).result())
                 except CoalescerClosedError:
                     out = None  # closed between check and submit
             if out is None:
                 # the snapshotted cache — a racing reload() may have
                 # already nulled self._cache
-                out = np.asarray(cache.run(batched, sem=self._semaphore))
+                out = np.asarray(cache.run(batched, sem=self._semaphore,
+                                           span=span))
         if jtensor:
             tensors = [JTensor.from_ndarray(o) for o in out]
             return tensors[0] if single else tensors

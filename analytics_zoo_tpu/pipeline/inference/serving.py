@@ -38,6 +38,8 @@ import numpy as np
 import jax
 
 from ...common.utils import pad_leading as _pad_rows
+from ...observability import profile as _profile
+from ...observability import trace as _trace
 
 
 def bucket_ladder(max_batch: int, growth: float = 2.0,
@@ -137,8 +139,12 @@ class BucketedExecutableCache:
                 return b
         return self.max_batch
 
-    def _dispatch(self, batched, bucket: int):
-        """Run one exactly-bucket-sized padded batch, with counters."""
+    def _dispatch(self, batched, bucket: int, spans: Sequence = ()):
+        """Run one exactly-bucket-sized padded batch, with counters.
+        ``spans`` are the riders' trace spans: each gets the
+        ``device_put`` -> ``execute`` phase transitions and its padded
+        bucket as a label (``execute`` stays open — it ends when the
+        owner starts ``depad`` after the fetch)."""
         sig = (bucket, batch_signature(batched))
         with self._lock:
             fresh = sig not in self._seen
@@ -148,15 +154,27 @@ class BucketedExecutableCache:
                     self.stats.misses.get(bucket, 0) + 1
             else:
                 self.stats.hits[bucket] = self.stats.hits.get(bucket, 0) + 1
+        for s in spans:
+            s.set_label("bucket", bucket)
+            s.phase_start("device_put")
         # explicit upload: handing numpy straight to the jit is an
         # IMPLICIT host->device transfer per dispatch — same bytes
         # moved, but invisible to jax's transfer guards.  device_put
         # keeps the hot loop clean under zoolint.sanitize() (and on a
         # real TPU makes the per-dispatch upload an auditable event).
         batched = jax.device_put(batched)
+        _profile.note_transfer("h2d")
+        for s in spans:
+            s.phase_start("execute")
         if fresh:
             t0 = time.perf_counter()
-            out = jax.block_until_ready(self._fn(batched))
+            # the dispatcher thread has no contextvar span, so the XLA
+            # profile hook would drop this compile's span event;
+            # activating the group's lead span here (cold path only)
+            # keeps the docstring promise that an unwarmed shape shows
+            # up IN the request's trace
+            with _trace.activate(spans[0] if spans else None):
+                out = jax.block_until_ready(self._fn(batched))
             with self._lock:
                 self.stats.compile_time_s[bucket] = \
                     self.stats.compile_time_s.get(bucket, 0.0) \
@@ -164,21 +182,26 @@ class BucketedExecutableCache:
             return out
         return self._fn(batched)
 
-    def run(self, batched, sem: Optional[threading.Semaphore] = None):
+    def run(self, batched, sem: Optional[threading.Semaphore] = None,
+            span=None):
         """Serve one host batch of any row count; returns HOST numpy
         results with padding rows removed.  ``sem`` (the owner's
         device-concurrency bound) is held around the DISPATCH only —
         the blocking host fetch happens outside it, so concurrent
-        callers' dispatches overlap each other's result transfers."""
+        callers' dispatches overlap each other's result transfers.
+        ``span`` (the request's trace span, if tracing) records the
+        pad/device_put/execute/depad phases — once per chunk for
+        oversized batches."""
         guard = sem if sem is not None else contextlib.nullcontext()
+        spans = (span,) if span is not None else ()
         n = _rows(batched)
         if n == 0:
             # run the smallest bucket and keep zero rows — the output
             # structure/shape contract stays intact for empty inputs
             with guard:
                 out = self._dispatch(_pad_rows(batched, self.buckets[0]),
-                                     self.buckets[0])
-            return fetch_rows(out, 0)
+                                     self.buckets[0], spans)
+            return fetch_rows(out, 0, span=span)
         outs = []
         start = 0
         while start < n:
@@ -186,14 +209,16 @@ class BucketedExecutableCache:
             chunk = _slice_rows(batched, start, start + take) \
                 if (start or take < n) else batched
             bucket = self.bucket_for(take)
+            if span is not None:
+                span.phase_start("pad")
             padded = _pad_rows(chunk, bucket - take)
             with guard:
-                out = self._dispatch(padded, bucket)
-            outs.append(fetch_rows(out, take))
+                out = self._dispatch(padded, bucket, spans)
+            outs.append(fetch_rows(out, take, span=span))
             start += take
         return _concat_trees(outs)
 
-    def dispatch_padded(self, batched):
+    def dispatch_padded(self, batched, spans: Sequence = ()):
         """Async single dispatch: pad to the bucket and return the
         DEVICE result tree without fetching.  jax dispatch is
         asynchronous, so the caller can overlap host work (gathering
@@ -205,7 +230,10 @@ class BucketedExecutableCache:
                 f"dispatch_padded: {n} rows exceed the top bucket "
                 f"{self.max_batch}; use run() for chunked serving")
         bucket = self.bucket_for(max(n, 1))
-        return self._dispatch(_pad_rows(batched, bucket - n), bucket)
+        for s in spans:
+            s.phase_start("pad")
+        return self._dispatch(_pad_rows(batched, bucket - n), bucket,
+                              spans)
 
     def warmup(self, sample_shapes, dtypes=None,
                buckets: Optional[Sequence[int]] = None) -> float:
@@ -232,20 +260,33 @@ class BucketedExecutableCache:
         return time.perf_counter() - t0
 
 
-def fetch_rows(device_tree, n: int):
-    """Block on a ``dispatch_padded`` result and strip the padding."""
+def fetch_rows(device_tree, n: int, span=None):
+    """Block on a ``dispatch_padded`` result and strip the padding.
+    With a ``span`` the blocking fetch closes the open ``execute``
+    phase (``depad`` starts once the bytes are on the host)."""
     host = jax.tree_util.tree_map(
         lambda a: np.asarray(jax.device_get(a)), device_tree)
-    return _slice_rows(host, 0, n)
+    _profile.note_transfer("d2h")
+    if span is not None:
+        span.phase_start("depad")
+    out = _slice_rows(host, 0, n)
+    if span is not None:
+        span.phase_end()
+    return out
 
 
 class _Request:
-    __slots__ = ("batched", "n", "sig", "future")
+    # ``span`` is the EXPLICIT cross-thread trace handoff: contextvars
+    # do not propagate into the dispatcher thread (started long before
+    # this request existed), so the pending request carries its span
+    # and the dispatcher records phases on it directly.
+    __slots__ = ("batched", "n", "sig", "future", "span")
 
-    def __init__(self, batched, n, sig):
+    def __init__(self, batched, n, sig, span=None):
         self.batched = batched
         self.n = n
         self.sig = sig
+        self.span = span
         self.future: Future = Future()
 
 
@@ -329,13 +370,18 @@ class RequestCoalescer:
         with self._out_lock:
             return self._outstanding
 
-    def submit(self, batched) -> Future:
+    def submit(self, batched, span=None) -> Future:
         n = _rows(batched)
         if n > self.max_batch:
             raise ValueError(
                 f"coalesced request of {n} rows exceeds max_batch "
                 f"{self.max_batch} — send it through the solo path")
-        req = _Request(batched, n, batch_signature(batched))
+        if span is not None:
+            # open here, on the caller's thread: coalesce_wait covers
+            # queue time + group gathering, ending when the dispatcher
+            # starts the group's pad phase
+            span.phase_start("coalesce_wait")
+        req = _Request(batched, n, batch_signature(batched), span)
         with self._submit_lock:
             if self.closed:
                 raise CoalescerClosedError(
@@ -474,12 +520,15 @@ class RequestCoalescer:
         """Concat + async dispatch; returns (group, rows, device_out)
         or None when the dispatch itself failed."""
         try:
+            spans = tuple(r.span for r in group if r.span is not None)
+            for s in spans:
+                s.phase_start("pad")  # ends coalesce_wait; covers concat
             batched = _concat_trees([r.batched for r in group]) \
                 if len(group) > 1 else group[0].batched
             n = sum(r.n for r in group)
             self._acquire_slot(inflight)
             try:
-                dev = self._cache.dispatch_padded(batched)
+                dev = self._cache.dispatch_padded(batched, spans)
             except BaseException:
                 if self._sem is not None:
                     self._sem.release()
@@ -511,12 +560,21 @@ class RequestCoalescer:
             if err is None:
                 off = 0
                 for r in group:
+                    if r.span is not None:
+                        r.span.phase_start("depad")
+                    rows = _slice_rows(out, off, off + r.n)
+                    if r.span is not None:
+                        # close depad BEFORE waking the caller so the
+                        # future-wake slack reads as span tail, not as
+                        # an inflated depad
+                        r.span.phase_end()
                     if not r.future.done():  # close() may have raced us
-                        r.future.set_result(
-                            _slice_rows(out, off, off + r.n))
+                        r.future.set_result(rows)
                     off += r.n
             else:
                 for r in group:
+                    if r.span is not None:
+                        r.span.phase_end()
                     if not r.future.done():
                         r.future.set_exception(err)
         finally:

@@ -7,11 +7,13 @@ coalescing).  See docs/serving.md §"Control plane"."""
 from .admission import AdmissionController
 from .errors import (DeadlineExceeded, DeployError, ModelNotFound,
                      Overloaded, ServingError, error_response)
-from .metrics import Counters, LatencyWindow
+from .metrics import (Counters, LatencyWindow, registry_collector,
+                      registry_families)
 from .registry import ModelRegistry
 
 __all__ = [
     "AdmissionController", "Counters", "DeadlineExceeded", "DeployError",
     "LatencyWindow", "ModelNotFound", "ModelRegistry", "Overloaded",
-    "ServingError", "error_response",
+    "ServingError", "error_response", "registry_collector",
+    "registry_families",
 ]

@@ -67,12 +67,18 @@ class AdmissionController:
 
     # ---- admission ----
     @contextlib.contextmanager
-    def admit(self, deadline_ms: Optional[float] = None):
+    def admit(self, deadline_ms: Optional[float] = None, span=None):
         """Admit (or shed) one request; run the service call in the
         ``with`` body.  Raises Overloaded / DeadlineExceeded instead of
-        queueing hopeless work."""
+        queueing hopeless work.  ``span`` (an observability trace span)
+        gets the ``admission_queue`` phase: opened here, closed by
+        whichever phase the data plane starts next — so queue wait and
+        slot wait are attributed, gap-free, even when admission is
+        instant."""
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
+        if span is not None:
+            span.phase_start("admission_queue")
         t0 = time.perf_counter()
         deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
         self._acquire(t0, deadline, deadline_ms)

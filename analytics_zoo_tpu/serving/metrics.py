@@ -1,73 +1,172 @@
-"""Serving metrics primitives: a sliding latency window and a plain
-counter bag, both thread-safe and snapshot-oriented (the control plane
-exposes point-in-time dicts, consumable as-is by ``GET /metrics``)."""
+"""Serving metrics: primitives re-homed to
+``analytics_zoo_tpu.observability.metrics`` (imported back here so
+every existing ``serving.metrics`` / ``serving.Counters`` consumer
+keeps working), plus the control-plane -> Prometheus bridge.
+
+The bridge is a scrape-time collector: it walks one
+``ModelRegistry.metrics()`` snapshot into exposition families with
+per-model / per-version / per-bucket labels, so wiring the whole
+control plane into a :class:`~..observability.metrics.MetricsRegistry`
+is one line::
+
+    mreg.register_collector(registry_collector(model_registry))
+"""
 
 from __future__ import annotations
 
-import threading
-from collections import deque
-from typing import Dict, Optional
+from typing import Any, Callable, Dict, List
+
+from ..observability.metrics import (Counters, Family, LatencyWindow,
+                                     summary_family)
+
+__all__ = ["Counters", "LatencyWindow", "registry_collector",
+           "registry_families"]
+
+_ADMISSION_GAUGES = ("queue_depth", "running", "queue_high_water",
+                     "max_queue", "max_concurrency")
+_ADMISSION_COUNTERS = ("admitted", "completed", "errors",
+                       "shed_overload", "shed_deadline",
+                       "shed_draining", "deadline_lapsed")
 
 
-class LatencyWindow:
-    """Sliding window of the most recent N request latencies with
-    percentile snapshots.
+def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
+    """One ``ModelRegistry.metrics()`` snapshot as Prometheus families
+    (per-model/version/bucket labels — see module docstring)."""
+    model_gauges: Dict[str, List] = {
+        "zoo_model_active_version": [],
+        "zoo_model_canary_fraction": [],
+        "zoo_coalescer_pending": [],
+    }
+    model_counters: Dict[str, List] = {"zoo_model_swap_total": []}
+    admission: Dict[str, List] = {
+        **{f"zoo_admission_{g}": [] for g in _ADMISSION_GAUGES},
+        **{f"zoo_admission_{c}_total": [] for c in _ADMISSION_COUNTERS},
+    }
+    version_counters: Dict[str, List] = {
+        "zoo_model_requests_total": [],
+        "zoo_model_errors_total": [],
+    }
+    version_gauges: Dict[str, List] = {"zoo_model_uptime_seconds": [],
+                                       "zoo_model_version_state": []}
+    bucket_counters: Dict[str, List] = {
+        "zoo_bucket_hits_total": [],
+        "zoo_bucket_misses_total": [],
+        "zoo_bucket_compile_seconds_total": [],
+    }
+    coalescer_counters: Dict[str, List] = {
+        "zoo_coalescer_dispatches_total": [],
+        "zoo_coalesced_requests_total": [],
+    }
+    # ONE summary family for every (model, version): emitting a Family
+    # per version would render duplicate # TYPE blocks for the same
+    # name, which real Prometheus parsers reject outright
+    latency_samples: List = []
 
-    A bounded deque, not a histogram: serving windows are small enough
-    (default 2048 samples) that exact percentiles over the raw samples
-    are cheaper and more faithful than bucket interpolation, and the
-    window self-ages — a traffic spike's tail latencies wash out after
-    N fresh requests instead of polluting a cumulative histogram
-    forever.
-    """
+    for model, m in sorted(snapshot.items()):
+        ml = {"model": model}
+        if m.get("active_version") is not None:
+            model_gauges["zoo_model_active_version"].append(
+                (ml, m["active_version"]))
+        model_counters["zoo_model_swap_total"].append(
+            (ml, m.get("swap_count", 0)))
+        model_gauges["zoo_model_canary_fraction"].append(
+            (ml, m.get("canary_fraction", 0.0)))
+        adm = m.get("admission", {})
+        for g in _ADMISSION_GAUGES:
+            if g in adm:
+                admission[f"zoo_admission_{g}"].append((ml, adm[g]))
+        for c in _ADMISSION_COUNTERS:
+            if c in adm:
+                admission[f"zoo_admission_{c}_total"].append(
+                    (ml, adm[c]))
+        for version, stats in sorted(m.get("versions", {}).items()):
+            # counters/summaries carry ONLY immutable labels: adding
+            # the mutable state would fork the series on every
+            # canary promote / hot-swap and break rate() continuity
+            # exactly at the event being monitored.  State rides a
+            # separate info-style gauge instead.
+            vl = {"model": model, "version": str(version)}
+            version_counters["zoo_model_requests_total"].append(
+                (vl, stats.get("requests", 0)))
+            version_counters["zoo_model_errors_total"].append(
+                (vl, stats.get("errors", 0)))
+            version_gauges["zoo_model_version_state"].append(
+                ({**vl, "state": str(stats.get("state", ""))}, 1))
+            if stats.get("uptime_s") is not None:
+                version_gauges["zoo_model_uptime_seconds"].append(
+                    (vl, stats["uptime_s"]))
+            lat = summary_family(
+                "zoo_model_latency_seconds",
+                "request latency over the sliding window",
+                vl, stats.get("latency", {}))
+            if lat is not None:
+                latency_samples.extend(lat.samples)
+        serving = m.get("serving", {})
+        for prom_name, key in (("zoo_bucket_hits_total", "hits"),
+                               ("zoo_bucket_misses_total", "misses"),
+                               ("zoo_bucket_compile_seconds_total",
+                                "compile_time_s")):
+            for bucket, v in sorted(serving.get(key, {}).items()):
+                bucket_counters[prom_name].append(
+                    ({"model": model, "bucket": str(bucket)}, v))
+        for prom_name, key in (
+                ("zoo_coalescer_dispatches_total", "dispatches"),
+                ("zoo_coalesced_requests_total", "coalesced_requests")):
+            if key in serving:
+                coalescer_counters[prom_name].append(
+                    (ml, serving[key]))
+        if "coalescer_pending" in serving:
+            model_gauges["zoo_coalescer_pending"].append(
+                (ml, serving["coalescer_pending"]))
 
-    def __init__(self, maxlen: int = 2048):
-        self._samples: deque = deque(maxlen=maxlen)
-        self._lock = threading.Lock()
-        self._count = 0
-        self._total_s = 0.0
+    help_text = {
+        "zoo_model_active_version": "active (serving) version number",
+        "zoo_model_swap_total": "completed hot-swaps",
+        "zoo_model_canary_fraction":
+            "fraction of traffic routed to the staged canary",
+        "zoo_coalescer_pending":
+            "submitted-but-unresolved coalesced requests",
+        "zoo_model_requests_total": "served requests per version",
+        "zoo_model_errors_total": "failed requests per version",
+        "zoo_model_uptime_seconds":
+            "seconds since this version deployed",
+        "zoo_model_version_state":
+            "info gauge: 1 for the version's current lifecycle state",
+        "zoo_bucket_hits_total": "bucket executable cache hits",
+        "zoo_bucket_misses_total":
+            "bucket cache misses (compiles paid)",
+        "zoo_bucket_compile_seconds_total":
+            "compile wall seconds per bucket",
+        "zoo_coalescer_dispatches_total": "coalesced device dispatches",
+        "zoo_coalesced_requests_total":
+            "requests served through coalesced dispatches",
+    }
+    out: List[Family] = []
+    gauge_groups = (model_gauges, version_gauges,
+                    {k: v for k, v in admission.items()
+                     if not k.endswith("_total")})
+    counter_groups = (model_counters, version_counters,
+                      bucket_counters, coalescer_counters,
+                      {k: v for k, v in admission.items()
+                       if k.endswith("_total")})
+    for groups, mtype in ((gauge_groups, "gauge"),
+                          (counter_groups, "counter")):
+        for group in groups:
+            for name, samples in group.items():
+                if samples:
+                    out.append(Family(
+                        mtype, name,
+                        help_text.get(name,
+                                      name.replace("zoo_", "")
+                                      .replace("_", " ")),
+                        samples))
+    if latency_samples:
+        out.append(Family("summary", "zoo_model_latency_seconds",
+                          "request latency over the sliding window",
+                          latency_samples))
+    return out
 
-    def add(self, seconds: float):
-        with self._lock:
-            self._samples.append(seconds)
-            self._count += 1
-            self._total_s += seconds
 
-    def snapshot(self) -> Dict[str, Optional[float]]:
-        with self._lock:
-            data = sorted(self._samples)
-            count, total = self._count, self._total_s
-
-        def pick(pct):
-            if not data:
-                return None
-            k = min(len(data) - 1,
-                    max(0, int(round((pct / 100.0) * (len(data) - 1)))))
-            return round(data[k] * 1e3, 3)
-
-        return {"count": count,
-                "mean_ms": (round(total / count * 1e3, 3)
-                            if count else None),
-                "p50_ms": pick(50), "p90_ms": pick(90),
-                "p99_ms": pick(99),
-                "window": len(data)}
-
-
-class Counters:
-    """A named bag of monotonically-increasing integers."""
-
-    def __init__(self, *names: str):
-        self._lock = threading.Lock()
-        self._c: Dict[str, int] = {n: 0 for n in names}
-
-    def inc(self, name: str, by: int = 1):
-        with self._lock:
-            self._c[name] = self._c.get(name, 0) + by
-
-    def get(self, name: str) -> int:
-        with self._lock:
-            return self._c.get(name, 0)
-
-    def snapshot(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._c)
+def registry_collector(model_registry) -> Callable[[], List[Family]]:
+    """Scrape-time collector over a live ``ModelRegistry``."""
+    return lambda: registry_families(model_registry.metrics())

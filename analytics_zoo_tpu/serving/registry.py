@@ -36,10 +36,12 @@ own ``BucketStats`` re-exported per model.
 
 from __future__ import annotations
 
+import datetime
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..observability import trace as _trace
 from .admission import AdmissionController
 from .errors import DeployError, ModelNotFound
 from .metrics import Counters, LatencyWindow
@@ -59,7 +61,14 @@ class _Deployment:
         self.deployed_at = time.time()
 
     def stats(self) -> Dict[str, Any]:
+        # deployed_at exports as ISO-8601 (UTC) — a raw epoch float in
+        # a metrics payload is unreadable and timezone-ambiguous; the
+        # uptime gauge is the number dashboards actually plot
+        deployed_iso = datetime.datetime.fromtimestamp(
+            self.deployed_at, datetime.timezone.utc).isoformat()
         return {"state": self.state, **self.counters.snapshot(),
+                "deployed_at": deployed_iso,
+                "uptime_s": round(time.time() - self.deployed_at, 3),
                 "latency": self.latency.snapshot()}
 
 
@@ -98,10 +107,13 @@ class ModelRegistry:
 
     def __init__(self, max_queue: int = 64, max_concurrency: int = 4,
                  default_deadline_ms: Optional[float] = None,
-                 **model_defaults: Any):
+                 tracer=None, **model_defaults: Any):
         self._max_queue = max_queue
         self._max_concurrency = max_concurrency
         self._default_deadline_ms = default_deadline_ms
+        # optional observability.Tracer: when set, every predict_ex
+        # carries a request span through admission and the data plane
+        self.tracer = tracer
         self._model_defaults = {
             "supported_concurrent_num": 4, "max_batch_size": 32,
             "coalescing": True, "max_wait_ms": 2.0, **model_defaults}
@@ -309,26 +321,55 @@ class ModelRegistry:
         return out
 
     def predict_ex(self, name: str, inputs,
-                   deadline_ms: Optional[float] = None
+                   deadline_ms: Optional[float] = None,
+                   trace_id: Optional[str] = None
                    ) -> Tuple[Any, Dict[str, Any]]:
         """predict + routing info ``{"model", "version", "canary"}`` —
         the web frontend tags responses with the serving version so
         clients (and the hot-swap tests) can see which side of a swap
         produced them.  Raises ModelNotFound / Overloaded /
-        DeadlineExceeded (structured, immediate)."""
+        DeadlineExceeded (structured, immediate).
+
+        With a tracer installed the request carries a span (id
+        ``trace_id`` when given — the frontend passes X-Request-Id)
+        through admission and the data plane; the span is activated for
+        this thread and handed across the coalescer explicitly, and
+        ``info`` gains ``request_id``.  Shed/failed requests finish
+        their span too, labeled with the error type."""
         entry = self._entry(name)
-        with entry.admission.admit(deadline_ms=deadline_ms):
-            dep, is_canary = self._route(entry)
-            t0 = time.perf_counter()
-            try:
-                out = dep.model.predict(inputs)
-            except BaseException:
-                dep.counters.inc("errors")
-                raise
-            dep.latency.add(time.perf_counter() - t0)
-            dep.counters.inc("requests")
-        return out, {"model": name, "version": dep.version,
-                     "canary": is_canary}
+        tracer = self.tracer
+        span = (tracer.start_span("predict", trace_id=trace_id,
+                                  model=name)
+                if tracer is not None else None)
+        try:
+            with _trace.activate(span), \
+                    entry.admission.admit(deadline_ms=deadline_ms,
+                                          span=span):
+                dep, is_canary = self._route(entry)
+                if span is not None:
+                    span.set_label("version", dep.version)
+                    if is_canary:
+                        span.set_label("canary", True)
+                t0 = time.perf_counter()
+                try:
+                    out = dep.model.predict(inputs)
+                except BaseException:
+                    dep.counters.inc("errors")
+                    raise
+                dep.latency.add(time.perf_counter() - t0)
+                dep.counters.inc("requests")
+        except BaseException as e:
+            if span is not None:
+                span.set_label("error", type(e).__name__)
+            raise
+        finally:
+            if span is not None:
+                span.finish()
+        info = {"model": name, "version": dep.version,
+                "canary": is_canary}
+        if span is not None:
+            info["request_id"] = span.trace_id
+        return out, info
 
     def _route(self, entry: _Entry) -> Tuple[_Deployment, bool]:
         """Pick the serving version.  Canary routing uses an error
@@ -418,6 +459,10 @@ class ModelRegistry:
             out[n] = {
                 "active_version": active.version if active else None,
                 "canary": canary_info,
+                # flat copy of the routed fraction (0.0 when no canary)
+                # so dashboards need not null-check the canary object
+                "canary_fraction": (canary_info["fraction"]
+                                    if canary_info else 0.0),
                 "swap_count": swaps,
                 "admission": e.admission.snapshot(),
                 "versions": versions,

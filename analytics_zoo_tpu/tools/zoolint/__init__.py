@@ -16,6 +16,8 @@ Rule codes (catalog with rationale: docs/dev/zoolint.md):
                        device work under a lock)
     ZL501/ZL502        thread lifecycle (unjoined non-daemon threads,
                        unbounded queues)
+    ZL601              bare print/stdlib logging on the hot path (use
+                       the structured logger with request-id fields)
 
 Runtime half (imports jax lazily, on first use):
 

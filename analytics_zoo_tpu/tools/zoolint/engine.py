@@ -11,7 +11,8 @@ from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 from .context import ModuleContext
 from .findings import Finding
-from .hotpath import DEFAULT_HOT_ENTRIES, rule_hot_path
+from .hotpath import (DEFAULT_HOT_ENTRIES, collect_hot_defs,
+                      rule_hot_logging, rule_hot_path)
 from .rules_concurrency import (rule_blocking_under_lock,
                                 rule_lock_discipline,
                                 rule_thread_lifecycle,
@@ -31,7 +32,8 @@ MODULE_RULES: Tuple[Callable[[ModuleContext], List[Finding]], ...] = (
 
 #: every rule code zoolint can emit (docs + fixture tests key off this)
 ALL_CODES = ("ZL101", "ZL102", "ZL103", "ZL201", "ZL202", "ZL203",
-             "ZL301", "ZL302", "ZL401", "ZL402", "ZL501", "ZL502")
+             "ZL301", "ZL302", "ZL401", "ZL402", "ZL501", "ZL502",
+             "ZL601")
 
 
 def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
@@ -71,6 +73,11 @@ def lint_paths(paths: Sequence[str], root: Optional[str] = None,
     for ctx in ctxs:
         for rule in MODULE_RULES:
             findings.extend(rule(ctx))
-    findings.extend(rule_hot_path(ctxs, hot_entries))
+    # the project-wide call-graph pass is computed ONCE and shared, so
+    # every hot-path rule sees the identical "hot" set for free
+    hot_defs = collect_hot_defs(ctxs, hot_entries)
+    findings.extend(rule_hot_path(ctxs, hot_entries, hot_defs=hot_defs))
+    findings.extend(rule_hot_logging(ctxs, hot_entries,
+                                     hot_defs=hot_defs))
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings

@@ -1,4 +1,4 @@
-"""Hot-path host-sync rules (project-wide, call-graph based).
+"""Hot-path rules (project-wide, call-graph based).
 
   ZL301  ``block_until_ready`` reachable from a serving hot entry point —
          a forced device sync on the request path serializes dispatch
@@ -7,6 +7,13 @@
          np.asarray / np.array / float() wrapped DIRECTLY around a
          dispatch call (``np.asarray(self._fn(x))``) — fetch explicitly
          via jax.device_get so transfer guards (and readers) see it.
+  ZL601  bare ``print(...)`` / stdlib ``logging`` call in a hot
+         function: free-text output from the request path cannot be
+         joined back to the request that produced it (and ``print``
+         grabs a global interpreter I/O lock mid-dispatch).  The
+         sanctioned path is the structured logger
+         (``analytics_zoo_tpu.observability.log.get_logger``), whose
+         records carry the current request id.
 
 The call graph is name-based and deliberately over-approximate: an edge
 ``f -> g`` exists when f's body calls anything whose final name is g
@@ -67,9 +74,12 @@ def _callees(fd: ast.AST) -> Set[str]:
     return out
 
 
-def rule_hot_path(ctxs: List[ModuleContext],
-                  hot_entries: Tuple[str, ...] = DEFAULT_HOT_ENTRIES
-                  ) -> List[Finding]:
+def collect_hot_defs(ctxs: List[ModuleContext],
+                     hot_entries: Tuple[str, ...] = DEFAULT_HOT_ENTRIES):
+    """The shared project pass: every def keyed by (path, qualname),
+    the set of hot-reachable keys (name-based BFS from the entry
+    points), and a path -> ModuleContext map.  Used by every hot-path
+    rule (ZL3xx, ZL601)."""
     # 1. collect every def in the project, keyed by (path, qualname)
     defs: Dict[Tuple[str, str], ast.AST] = {}
     by_final: Dict[str, List[Tuple[str, str]]] = {}
@@ -94,8 +104,19 @@ def rule_hot_path(ctxs: List[ModuleContext],
                 if nxt not in hot:
                     hot.add(nxt)
                     frontier.append(nxt)
+    return defs, hot, ctx_of
 
-    # 3. flag sync / implicit-materialize sites inside hot defs
+
+def rule_hot_path(ctxs: List[ModuleContext],
+                  hot_entries: Tuple[str, ...] = DEFAULT_HOT_ENTRIES,
+                  hot_defs=None) -> List[Finding]:
+    """``hot_defs``: the precomputed ``collect_hot_defs`` triple — the
+    engine computes it once and shares it with every hot-path rule;
+    standalone callers may omit it."""
+    defs, hot, ctx_of = (hot_defs if hot_defs is not None
+                         else collect_hot_defs(ctxs, hot_entries))
+
+    # flag sync / implicit-materialize sites inside hot defs
     findings: List[Finding] = []
     for (path, qual) in sorted(hot):
         fd = defs[(path, qual)]
@@ -128,4 +149,91 @@ def rule_hot_path(ctxs: List[ModuleContext],
                     "dispatch result on the hot path — wrap the fetch "
                     "in jax.device_get (explicit transfers pass "
                     "transfer guards; implicit ones abort them)"))
+    return findings
+
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error",
+                "exception", "critical", "log"}
+
+
+def _stdlib_logger_names(ctx: ModuleContext) -> Set[str]:
+    """Local names bound to ``logging.getLogger(...)`` results — both
+    ``log = logging.getLogger(...)`` and ``self._log = ...`` (matched
+    by final attribute name)."""
+    names: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and ctx.resolve(node.value.func) == "logging.getLogger"):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                names.add(t.attr)
+    return names
+
+
+def _is_stdlib_log_call(ctx: ModuleContext, node: ast.Call,
+                        logger_names: Set[str]) -> bool:
+    func = node.func
+    resolved = ctx.resolve(func)
+    # logging.info(...) / logging.getLogger("x").info(...)
+    if resolved is not None and resolved.startswith("logging."):
+        return resolved.rsplit(".", 1)[-1] in _LOG_METHODS
+    if not (isinstance(func, ast.Attribute)
+            and func.attr in _LOG_METHODS):
+        return False
+    recv = func.value
+    if isinstance(recv, ast.Call) and \
+            ctx.resolve(recv.func) == "logging.getLogger":
+        return True  # logging.getLogger(...).warning(...)
+    if isinstance(recv, ast.Name):
+        return recv.id in logger_names
+    if isinstance(recv, ast.Attribute):
+        return recv.attr in logger_names  # self._log.info(...)
+    return False
+
+
+def rule_hot_logging(ctxs: List[ModuleContext],
+                     hot_entries: Tuple[str, ...] = DEFAULT_HOT_ENTRIES,
+                     hot_defs=None) -> List[Finding]:
+    """ZL601: bare print / stdlib logging inside hot-reachable
+    functions.  The structured logger
+    (``analytics_zoo_tpu.observability.log.get_logger``) is exempt by
+    construction: its instances are not created via
+    ``logging.getLogger`` in the flagged module, and its records carry
+    the current request id — which is the point."""
+    defs, hot, ctx_of = (hot_defs if hot_defs is not None
+                         else collect_hot_defs(ctxs, hot_entries))
+    logger_names = {ctx.path: _stdlib_logger_names(ctx) for ctx in ctxs}
+    findings: List[Finding] = []
+    for (path, qual) in sorted(hot):
+        fd = defs[(path, qual)]
+        ctx = ctx_of[path]
+        for node in ast.walk(fd):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id == "print":
+                findings.append(Finding(
+                    "ZL601", path, node.lineno, node.col_offset, qual,
+                    "print() on the serving hot path (reachable from "
+                    f"{'/'.join(hot_entries)}): free-text output "
+                    "cannot be joined back to its request and takes a "
+                    "global I/O lock mid-dispatch — use the "
+                    "structured logger (analytics_zoo_tpu."
+                    "observability.log.get_logger), whose records "
+                    "carry the request id; baseline with a "
+                    "justification if the output IS the tool's UI"))
+            elif _is_stdlib_log_call(ctx, node, logger_names[path]):
+                findings.append(Finding(
+                    "ZL601", path, node.lineno, node.col_offset, qual,
+                    "stdlib logging call on the serving hot path — "
+                    "free-text records drop the request id.  Use the "
+                    "structured logger (analytics_zoo_tpu."
+                    "observability.log.get_logger) so the record "
+                    "carries request_id and joins the trace; baseline "
+                    "with a justification for intentional module-level "
+                    "diagnostics"))
     return findings

@@ -370,12 +370,12 @@ class Trainer:
         if not divisible and not Trainer._warned_replicated:
             # correct but every device redundantly computes the full batch
             Trainer._warned_replicated = True
-            import logging
-            logging.getLogger("analytics_zoo_tpu").warning(
-                "batch of %d does not divide the data-parallel degree %d "
-                "— falling back to replicated compute (every device runs "
+            from ..observability.log import get_logger
+            get_logger("analytics_zoo_tpu.train").warning(
+                "batch does not divide the data-parallel degree — "
+                "falling back to replicated compute (every device runs "
                 "the full batch). Pad the batch for full speed.",
-                len(first), dp)
+                batch=len(first), data_parallel=dp)
         sharding = self._batch_sharding if divisible else self._repl_sharding
         put = lambda a: dist_lib.put_global(a, sharding,
                                             batch_sharded=divisible)

@@ -5,16 +5,26 @@ consuming the thread-safe POJO serving API
 (AbstractInferenceModel.java:30-148).  Here the same role is played by
 ``ModelRegistry`` (analytics_zoo_tpu.serving): named + versioned
 models, zero-downtime hot-swap, per-model admission control with
-deadline-aware load shedding, and a metrics snapshot.
+deadline-aware load shedding, and full observability (per-request
+tracing, Prometheus metrics, XLA profiling hooks).
 
 POST /predict {"instances": [[...], ...],              -> {"predictions": [...],
-               "model": "default",       # optional        "model": ..., "version": ...}
-               "deadline_ms": 250}       # optional
+               "model": "default",       # optional        "model": ..., "version": ...,
+               "deadline_ms": 250}       # optional        "request_id": ...}
 POST /deploy  {"model": "default", "seed": 1,          -> {"model": ..., "version": v}
                "hidden": 16, "canary_fraction": 0.2}   # canary optional
 POST /promote {"model": "default"}                     -> {"version": v}
-GET  /metrics                                          -> registry.metrics()
+GET  /metrics                                          -> registry.metrics() (JSON)
+GET  /metrics?format=prometheus                        -> text exposition 0.0.4
+GET  /traces                                           -> recent trace ring buffer
+GET  /traces?id=<request_id>                           -> one trace (404 if aged out)
 GET  /health                                           -> {"status": "ok"}
+
+Every /predict response carries an ``X-Request-Id`` header (client's
+own header is honored, else generated) matching the trace id in
+``GET /traces`` — latency questions resolve to per-phase spans
+(admission_queue/coalesce_wait/pad/device_put/execute/depad), not
+guesswork.
 
 Overload/miss surface: 429 Overloaded (queue full / draining),
 504 DeadlineExceeded (shed or lapsed), 404 ModelNotFound — all with a
@@ -24,19 +34,23 @@ Run standalone:  python web_service.py --port 8900
 (then:  curl -d '{"instances": [[0.1, ...]]}' localhost:8900/predict)
 With --self-test the app starts the server, fires concurrent client
 traffic, HOT-SWAPS the model mid-traffic (zero failed requests, every
-response tagged with exactly one version), checks /metrics, and exits.
+response tagged with exactly one version), checks /metrics (JSON and
+Prometheus, round-tripped through the stdlib parser), verifies a
+traced request's phases sum to its span wall time, and exits.
 """
 
 import argparse
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 DEFAULT_MODEL = "default"
 N_FEATURES = 8
 N_CLASSES = 3
+TRACE_RING = 512
 
 
 def build_net(hidden: int = 16, seed: int = 0):
@@ -58,30 +72,51 @@ def build_net(hidden: int = 16, seed: int = 0):
 
 
 def build_registry():
-    """The control plane: one registry, the default model deployed and
-    warmed before the server accepts traffic."""
-    from analytics_zoo_tpu.serving import ModelRegistry
+    """The control plane + observability: one registry with a tracer,
+    a Prometheus-exposable metrics registry fed by the control plane /
+    tracer / XLA hooks, and the default model deployed and warmed
+    before the server accepts traffic.  Returns (registry, obs) where
+    ``obs`` = {"tracer", "metrics", "profile"}."""
+    from analytics_zoo_tpu.observability import (MetricsRegistry, Tracer,
+                                                 profile)
+    from analytics_zoo_tpu.serving import ModelRegistry, registry_collector
 
+    tracer = Tracer(capacity=TRACE_RING)
     registry = ModelRegistry(max_queue=64, max_concurrency=4,
                              supported_concurrent_num=4,
-                             max_batch_size=32, coalescing=True)
+                             max_batch_size=32, coalescing=True,
+                             tracer=tracer)
+    metrics = MetricsRegistry()
+    metrics.register_collector(registry_collector(registry))
+    metrics.register_collector(tracer.families)
+    prof = profile.install()
+    metrics.register_collector(prof.families)
     registry.deploy(DEFAULT_MODEL, build_net(),
                     warmup_shapes=(N_FEATURES,))
-    return registry
+    return registry, {"tracer": tracer, "metrics": metrics,
+                      "profile": prof}
 
 
-def make_handler(registry):
+def make_handler(registry, obs=None):
     from analytics_zoo_tpu.serving import error_response
+
+    tracer = (obs or {}).get("tracer")
+    metrics = (obs or {}).get("metrics")
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
 
-        def _reply(self, code, payload):
+        def _reply(self, code, payload, headers=None):
             body = json.dumps(payload).encode()
+            self._reply_raw(code, body, "application/json", headers)
+
+        def _reply_raw(self, code, body, content_type, headers=None):
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -90,10 +125,47 @@ def make_handler(registry):
             return json.loads(self.rfile.read(n) or b"{}")
 
         def do_GET(self):
-            if self.path == "/health":
+            try:
+                self._do_get()
+            except Exception as e:  # same structured surface as POST
+                self._reply(*error_response(e))
+
+        def _do_get(self):
+            url = urlparse(self.path)
+            query = parse_qs(url.query)
+            if url.path == "/health":
                 self._reply(200, {"status": "ok"})
-            elif self.path == "/metrics":
-                self._reply(200, registry.metrics())
+            elif url.path == "/metrics":
+                fmt = (query.get("format") or ["json"])[0]
+                if fmt == "prometheus":
+                    if metrics is None:
+                        self._reply(404, {
+                            "error": "prometheus exposition not wired"})
+                        return
+                    self._reply_raw(
+                        200, metrics.render_prometheus().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._reply(200, registry.metrics())
+            elif url.path == "/traces":
+                if tracer is None:
+                    self._reply(404, {"error": "tracing not wired"})
+                    return
+                trace_id = (query.get("id") or [None])[0]
+                if trace_id is not None:
+                    found = tracer.find(trace_id)
+                    if found is None:
+                        self._reply(404, {
+                            "error": "trace not found (aged out of the "
+                                     "ring buffer?)", "id": trace_id})
+                    else:
+                        self._reply(200, found)
+                else:
+                    n = int((query.get("n") or [50])[0])
+                    self._reply(200, {
+                        "traces": tracer.recent(n),
+                        "phase_stats": tracer.phase_stats(),
+                        "span_count": tracer.span_count})
             else:
                 self._reply(404, {"error": "unknown path"})
 
@@ -101,12 +173,20 @@ def make_handler(registry):
             try:
                 payload = self._body()
                 if self.path == "/predict":
+                    # prefix+counter, not uuid4 — a fresh uuid costs
+                    # ~40us, material per request (PERF_NOTES §PR 4)
+                    from analytics_zoo_tpu.observability.trace import \
+                        new_trace_id
+                    rid = (self.headers.get("X-Request-Id")
+                           or new_trace_id())
                     x = np.asarray(payload["instances"], dtype=np.float32)
                     preds, info = registry.predict_ex(
                         payload.get("model", DEFAULT_MODEL), x,
-                        deadline_ms=payload.get("deadline_ms"))
+                        deadline_ms=payload.get("deadline_ms"),
+                        trace_id=rid)
                     self._reply(200, {
-                        "predictions": np.asarray(preds).tolist(), **info})
+                        "predictions": np.asarray(preds).tolist(), **info},
+                        headers={"X-Request-Id": rid})
                 elif self.path == "/deploy":
                     name = payload.get("model", DEFAULT_MODEL)
                     net = build_net(hidden=int(payload.get("hidden", 16)),
@@ -132,19 +212,25 @@ def make_handler(registry):
 def self_test(port: int):
     """Concurrent clients + a hot-swap mid-traffic: zero failed
     requests, every response tagged with exactly one version, /metrics
-    coherent afterwards."""
+    coherent afterwards — then the observability checks: a traced
+    request whose phase durations sum to ~its span wall, and the
+    Prometheus exposition round-tripped through the stdlib parser."""
     from urllib.request import Request, urlopen
 
-    def call(path, payload=None):
+    from analytics_zoo_tpu.observability import parse_prometheus_text
+
+    def call(path, payload=None, return_headers=False):
         if payload is None:
-            with urlopen(f"http://127.0.0.1:{port}{path}",
-                         timeout=30) as r:
-                return json.loads(r.read())
-        req = Request(f"http://127.0.0.1:{port}{path}",
-                      data=json.dumps(payload).encode(),
-                      headers={"Content-Type": "application/json"})
+            req = f"http://127.0.0.1:{port}{path}"
+        else:
+            req = Request(f"http://127.0.0.1:{port}{path}",
+                          data=json.dumps(payload).encode(),
+                          headers={"Content-Type": "application/json"})
         with urlopen(req, timeout=30) as resp:
-            return json.loads(resp.read())
+            body = resp.read()
+            if return_headers:
+                return json.loads(body), dict(resp.headers)
+        return json.loads(body)
 
     assert call("/health")["status"] == "ok"
 
@@ -209,6 +295,63 @@ def self_test(port: int):
     assert m["admission"]["errors"] == 0
     assert m["admission"]["completed"] >= total
     assert m["serving"]["buckets"], "active version lost its fast path"
+    # registry metric satellites: ISO deploy stamp + uptime + canary
+    vstats = m["versions"][str(swap["version"])]  # JSON keys: strings
+    assert "T" in vstats["deployed_at"], vstats["deployed_at"]
+    assert vstats["uptime_s"] >= 0
+    assert m["canary_fraction"] == 0.0
+
+    # ---- tracing: one trace per request, phases account for the wall.
+    # A big batch (chunked over the bucket ladder) makes device work
+    # dominate, so the untraced slack (future wake-up, JSON) stays
+    # under 5% of the span wall; quiet retries absorb scheduler noise.
+    big = rs.rand(128, N_FEATURES).tolist()
+    best = None
+    for _ in range(10):
+        out, headers = call("/predict", {"instances": big},
+                            return_headers=True)
+        rid = headers.get("X-Request-Id")
+        assert rid and out["request_id"] == rid
+        tr = call(f"/traces?id={rid}")
+        assert tr["trace_id"] == rid
+        phase_names = {p["name"] for p in tr["phases"]}
+        assert {"pad", "device_put", "execute", "depad"} <= phase_names, \
+            phase_names
+        assert tr["labels"]["model"] == DEFAULT_MODEL
+        assert tr["labels"]["version"] == swap["version"]
+        if best is None or tr["coverage"] > best["coverage"]:
+            best = tr
+        if best["coverage"] >= 0.95:
+            break
+    assert best["coverage"] >= 0.95, \
+        f"phase durations cover only {best['coverage']:.1%} of the " \
+        f"span wall ({best['wall_ms']:.2f} ms): {best['phases']}"
+    print(f"trace check: request {best['trace_id']} wall "
+          f"{best['wall_ms']:.2f} ms, phases sum "
+          f"{best['phase_total_ms']:.2f} ms "
+          f"(coverage {best['coverage']:.1%}) OK")
+
+    # ---- Prometheus exposition: scrape + round-trip the parser; the
+    # per-model/version/bucket labels must survive.
+    with urlopen(f"http://127.0.0.1:{port}/metrics?format=prometheus",
+                 timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    parsed = parse_prometheus_text(text)  # raises on any bad line
+    names = {k[0] for k in parsed["samples"]}
+    for required in ("zoo_model_requests_total", "zoo_bucket_hits_total",
+                     "zoo_trace_spans_total", "zoo_xla_compiles_total",
+                     "zoo_admission_completed_total"):
+        assert required in names, f"{required} missing from exposition"
+    labeled = [k for k in parsed["samples"]
+               if k[0] == "zoo_model_requests_total"]
+    assert any(dict(k[1]).get("model") == DEFAULT_MODEL
+               and dict(k[1]).get("version") == str(swap["version"])
+               for k in labeled), labeled
+    assert parsed["types"]["zoo_model_requests_total"] == "counter"
+    print(f"prometheus scrape OK ({len(parsed['samples'])} samples, "
+          f"{len(names)} series names)")
+
     print(f"web-service self-test: {n_clients} concurrent clients, "
           f"hot-swap v1->v{swap['version']} mid-traffic, {total} requests, "
           f"0 failed, versions seen {sorted(versions)} OK")
@@ -220,12 +363,13 @@ def main():
     ap.add_argument("--self-test", action="store_true")
     args = ap.parse_args()
 
-    registry = build_registry()
+    registry, obs = build_registry()
     server = ThreadingHTTPServer(("127.0.0.1", args.port),
-                                 make_handler(registry))
+                                 make_handler(registry, obs))
     port = server.server_address[1]
     print(f"serving on http://127.0.0.1:{port} (POST /predict /deploy "
-          "/promote, GET /health /metrics)", flush=True)
+          "/promote, GET /health /metrics[?format=prometheus] /traces)",
+          flush=True)
     if args.self_test:
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()
@@ -234,11 +378,13 @@ def main():
         finally:
             server.shutdown()
             registry.shutdown()
+            obs["profile"].close()
     else:
         try:
             server.serve_forever()
         finally:
             registry.shutdown()
+            obs["profile"].close()
 
 
 if __name__ == "__main__":

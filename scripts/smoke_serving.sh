@@ -1,13 +1,27 @@
 #!/usr/bin/env bash
 # Serving smoke gate: the web-service sample's --self-test end to end
 # on CPU — registry deploy + warmup, concurrent clients, a hot-swap
-# mid-traffic with zero failed requests, and a coherent /metrics.
+# mid-traffic with zero failed requests, a coherent /metrics, a traced
+# request whose phases account for its span wall, and a Prometheus
+# scrape round-tripped through the stdlib exposition parser
+# (observability.metrics.parse_prometheus_text — an unparseable line
+# fails the self-test, and the grep below keeps the scrape from being
+# silently skipped).
 #
 # Runnable standalone (like check_collection.sh) and cheap enough for
 # CI: one process, ~1 min on a cold CPU.  The timeout wrapper keeps a
 # wedged dispatcher/server from hanging the gate forever.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
-    python apps/web-service-sample/web_service.py --self-test
+out=$(timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    python apps/web-service-sample/web_service.py --self-test)
+printf '%s\n' "$out"
+grep -q "prometheus scrape OK" <<<"$out" || {
+    echo "smoke FAIL: self-test never scraped /metrics?format=prometheus" >&2
+    exit 1
+}
+grep -q "trace check: " <<<"$out" || {
+    echo "smoke FAIL: self-test never verified a request trace" >&2
+    exit 1
+}
 echo "serving smoke OK"

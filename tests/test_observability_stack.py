@@ -1,0 +1,531 @@
+"""The observability stack (ISSUE 4): per-request tracing with
+cross-thread handoff, the unified metrics registry + Prometheus
+round-trip, the re-homed LatencyWindow/Counters edge cases, XLA
+profiling hooks, and the traced end-to-end serving path.
+
+The ZL601 fixture pair rides the parametrized harness in
+test_zoolint.py (ALL_CODES); the web-surface checks (X-Request-Id,
+/traces, /metrics?format=prometheus) live in test_web_service.py.
+"""
+
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.observability import (Counters, Family,
+                                             LatencyWindow,
+                                             MetricsRegistry, Span,
+                                             Tracer, current_span,
+                                             parse_prometheus_text,
+                                             render_prometheus,
+                                             summary_family, trace)
+
+
+def _phase_names(d):
+    """Consecutive-deduped phase names of a span dict (a phase may
+    legally recur, e.g. pad in the dispatcher then in the cache)."""
+    return [k for k, _ in itertools.groupby(p["name"] for p in d["phases"])]
+
+
+# ------------------------------------------------------------ tracing
+def test_span_phases_are_contiguous_by_construction():
+    tracer = Tracer()
+    span = tracer.start_span("r")
+    span.phase_start("a")
+    span.phase_start("b")  # closes a at b's start timestamp
+    span.phase_end()
+    span.finish()
+    d = tracer.recent()[0]
+    a, b = d["phases"]
+    assert a["name"] == "a" and b["name"] == "b"
+    # to_dict rounds ms to 4 decimals, so equality holds to ~1e-4 ms
+    assert abs(a["start_ms"] + a["dur_ms"] - b["start_ms"]) < 1e-3
+    assert d["phase_total_ms"] <= d["wall_ms"] + 1e-3
+    assert 0.0 < d["coverage"] <= 1.0
+
+
+def test_span_finish_closes_open_phase_and_is_idempotent():
+    span = Span(None, "r")
+    span.phase_start("x")
+    span.finish()
+    assert span.phases[0][2] is not None
+    end = span.end_s
+    span.finish()
+    assert span.end_s == end
+
+
+def test_span_repeated_phases_aggregate_by_name():
+    span = Span(None, "r")
+    for _ in range(3):
+        with span.phase("pad"):
+            pass
+        with span.phase("execute"):
+            pass
+    span.finish()
+    totals = span.phase_totals()
+    assert set(totals) == {"pad", "execute"}
+    assert len(span.phases) == 6
+
+
+def test_tracer_ring_buffer_is_bounded_and_aggregates_all():
+    tracer = Tracer(capacity=4)
+    for i in range(10):
+        s = tracer.start_span("r", trace_id=f"t{i}")
+        with s.phase("execute"):
+            pass
+        s.finish()
+    assert tracer.span_count == 10
+    recent = tracer.recent()
+    assert len(recent) == 4  # ring keeps the newest N
+    assert [d["trace_id"] for d in recent] == ["t6", "t7", "t8", "t9"]
+    assert tracer.find("t3") is None  # aged out
+    assert tracer.find("t9") is not None
+    assert tracer.recent(2) == recent[-2:]
+    assert tracer.recent(0) == []  # not "everything" via [-0:]
+    assert tracer.recent(-3) == []
+    # aggregation covers ALL 10 spans, not just the surviving ring
+    assert tracer.phase_stats()["execute"]["count"] == 10
+
+
+def test_activate_sets_current_span_and_restores_on_exit():
+    assert current_span() is None
+    span = Span(None, "r")
+    with trace.activate(span):
+        assert trace.tracing_active()  # sticky once anything traced
+        assert current_span() is span
+        # nesting: inner span wins, outer restored after
+        inner = Span(None, "inner")
+        with trace.activate(inner):
+            assert current_span() is inner
+        assert current_span() is span
+    assert current_span() is None
+    # activate(None) is a no-op passthrough (the untraced fast path)
+    with trace.activate(None):
+        assert current_span() is None
+
+
+def test_activate_does_not_leak_across_threads_but_handoff_works():
+    """contextvars don't reach a pre-existing worker thread; the
+    explicit span-carry (what the coalescer does) is the supported
+    handoff."""
+    span = Span(None, "r")
+    seen = {}
+    handed = {}
+    ready = threading.Event()
+    go = threading.Event()
+
+    def worker():
+        ready.set()
+        go.wait(5)
+        seen["ctx"] = current_span()       # NOT propagated
+        handed["span"] = carried[0]        # explicit carry IS
+        handed["span"].phase_start("execute")
+        handed["span"].phase_end()
+
+    carried = [span]
+    t = threading.Thread(target=worker)
+    t.start()
+    ready.wait(5)
+    with trace.activate(span):
+        go.set()
+        t.join(5)
+    assert seen["ctx"] is None
+    assert span.phase_totals()["execute"] >= 0.0
+
+
+# ------------------------------------------- LatencyWindow / Counters
+def test_latency_window_empty_snapshot():
+    w = LatencyWindow()
+    snap = w.snapshot()
+    assert snap["count"] == 0 and snap["window"] == 0
+    assert snap["mean_ms"] is None
+    assert snap["p50_ms"] is None and snap["p99_ms"] is None
+
+
+def test_latency_window_single_sample_answers_every_percentile():
+    w = LatencyWindow()
+    w.add(0.005)
+    snap = w.snapshot()
+    assert snap["count"] == 1 and snap["window"] == 1
+    assert snap["p50_ms"] == snap["p90_ms"] == snap["p99_ms"] == 5.0
+    assert snap["mean_ms"] == 5.0
+
+
+def test_latency_window_nearest_rank_at_window_boundary():
+    """Overfill a tiny window: the deque keeps the newest maxlen
+    samples, count keeps the lifetime total, and the nearest-rank
+    picks hit the window min/max exactly at the extremes."""
+    w = LatencyWindow(maxlen=4)
+    for ms in (9.0, 1.0, 2.0, 3.0, 4.0):  # 9.0 ages out
+        w.add(ms / 1e3)
+    snap = w.snapshot()
+    assert snap["count"] == 5 and snap["window"] == 4
+    assert snap["p99_ms"] == 4.0     # nearest-rank top == window max
+    assert snap["p50_ms"] == 3.0     # round(0.5*3)=2 -> sorted[2]
+    assert snap["p90_ms"] == 4.0     # round(0.9*3)=3 -> sorted[3]
+
+
+def test_latency_window_concurrent_add_and_snapshot():
+    w = LatencyWindow(maxlen=128)
+    stop = threading.Event()
+    errs = []
+
+    def adder():
+        i = 0
+        while not stop.is_set():
+            w.add(0.001 * (i % 7 + 1))
+            i += 1
+
+    def snapper():
+        while not stop.is_set():
+            snap = w.snapshot()
+            if snap["count"] and not (snap["p50_ms"] <= snap["p99_ms"]):
+                errs.append(snap)
+
+    threads = [threading.Thread(target=f)
+               for f in (adder, adder, snapper, snapper)]
+    [t.start() for t in threads]
+    time.sleep(0.2)
+    stop.set()
+    [t.join() for t in threads]
+    assert not errs
+    assert w.snapshot()["count"] >= 128
+
+
+def test_counters_unknown_name_and_concurrent_inc():
+    c = Counters("a")
+    assert c.get("missing") == 0
+    threads = [threading.Thread(
+        target=lambda: [c.inc("a") for _ in range(500)])
+        for _ in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert c.get("a") == 2000
+    assert c.snapshot() == {"a": 2000}
+
+
+# ----------------------------------------------------------- registry
+def test_metrics_registry_counter_gauge_and_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("zoo_reqs_total", "reqs")
+    assert reg.counter("zoo_reqs_total") is c  # idempotent by name
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("zoo_reqs_total")
+    c.labels(model="m", version="1").inc()
+    c.labels(model="m", version="1").inc(2)
+    assert c.get(model="m", version="1") == 3
+    with pytest.raises(ValueError, match="only go up"):
+        c.inc(-1)
+    g = reg.gauge("zoo_depth")
+    g.set(5)
+    g.labels(model="m").set_fn(lambda: 11)
+    assert g.get() == 5 and g.get(model="m") == 11
+    with pytest.raises(TypeError):
+        c.labels(model="m").set(1)
+
+
+def test_prometheus_render_parse_round_trip_with_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("zoo_reqs_total", "help with\nnewline")
+    nasty = 'quo"te\\slash\nnewline'
+    c.labels(model=nasty).inc(7)
+    reg.gauge("zoo_nan_gauge").set_fn(lambda: float("nan"))
+    text = reg.render_prometheus()
+    parsed = parse_prometheus_text(text)  # must not raise
+    assert parsed["samples"][
+        ("zoo_reqs_total", (("model", nasty),))] == 7.0
+    assert parsed["types"] == {"zoo_reqs_total": "counter",
+                               "zoo_nan_gauge": "gauge"}
+    # collector families merge into the same scrape
+    reg.register_collector(lambda: [Family(
+        "counter", "zoo_extra_total", "", [({"k": "v"}, 1)])])
+    assert ("zoo_extra_total", (("k", "v"),)) in \
+        parse_prometheus_text(reg.render_prometheus())["samples"]
+
+
+def test_render_merges_same_named_families_single_type_block():
+    """Independent collectors may emit the same family name (e.g. one
+    latency summary per model): they must merge into ONE # TYPE block —
+    real Prometheus parsers hard-reject duplicate TYPE lines — and a
+    type conflict must raise rather than ship invalid exposition."""
+    fams = [Family("counter", "zoo_x_total", "h", [({"m": "a"}, 1)]),
+            Family("counter", "zoo_x_total", "h", [({"m": "b"}, 2)])]
+    text = render_prometheus(fams)
+    assert text.count("# TYPE zoo_x_total counter") == 1
+    parsed = parse_prometheus_text(text)
+    assert parsed["samples"][("zoo_x_total", (("m", "a"),))] == 1.0
+    assert parsed["samples"][("zoo_x_total", (("m", "b"),))] == 2.0
+    with pytest.raises(ValueError, match="both"):
+        render_prometheus([
+            Family("counter", "zoo_y", "", [({}, 1)]),
+            Family("gauge", "zoo_y", "", [({}, 2)])])
+
+
+def test_registry_latency_summaries_share_one_family_across_versions():
+    from analytics_zoo_tpu.serving import registry_families
+    snapshot = {"m": {
+        "active_version": 2, "swap_count": 1, "canary": None,
+        "canary_fraction": 0.0, "admission": {}, "serving": {},
+        "versions": {
+            1: {"state": "retired", "requests": 5, "errors": 0,
+                "latency": {"count": 5, "mean_ms": 1.0, "total_s": 0.005,
+                            "p50_ms": 1.0, "p90_ms": 1.0, "p99_ms": 1.0,
+                            "window": 5}},
+            2: {"state": "active", "requests": 3, "errors": 0,
+                "latency": {"count": 3, "mean_ms": 2.0, "total_s": 0.006,
+                            "p50_ms": 2.0, "p90_ms": 2.0, "p99_ms": 2.0,
+                            "window": 3}}}}}
+    fams = registry_families(snapshot)
+    lat = [f for f in fams if f.name == "zoo_model_latency_seconds"]
+    assert len(lat) == 1  # one family, both versions' samples inside
+    text = render_prometheus(fams)
+    assert text.count("# TYPE zoo_model_latency_seconds summary") == 1
+    parsed = parse_prometheus_text(text)
+    assert parsed["samples"][
+        ("zoo_model_latency_seconds_count",
+         (("model", "m"), ("version", "1")))] == 5.0
+    assert parsed["samples"][
+        ("zoo_model_latency_seconds_count",
+         (("model", "m"), ("version", "2")))] == 3.0
+
+
+def test_prometheus_parser_rejects_garbage():
+    for bad in ("metric{unclosed=\"x\" 1",
+                "metric{k=\"bad\\q\"} 1",
+                "0leading_digit 2",
+                "metric one_point_five",
+                "# TYPE zoo bogus_type"):
+        with pytest.raises(ValueError, match="unparseable|bogus|TYPE"):
+            parse_prometheus_text(bad + "\n")
+    # free-form comments and blank lines are legal
+    out = parse_prometheus_text("# a comment\n\nm_total 3\n")
+    assert out["samples"][("m_total", ())] == 3.0
+
+
+def test_summary_family_from_latency_window():
+    w = LatencyWindow()
+    for s in (0.001, 0.002, 0.003):
+        w.add(s)
+    fam = summary_family("zoo_lat_seconds", "lat", {"model": "m"},
+                         w.snapshot())
+    parsed = parse_prometheus_text(render_prometheus([fam]))
+    assert parsed["types"]["zoo_lat_seconds"] == "summary"
+    assert parsed["samples"][
+        ("zoo_lat_seconds_count", (("model", "m"),))] == 3.0
+    assert abs(parsed["samples"][
+        ("zoo_lat_seconds_sum", (("model", "m"),))] - 0.006) < 1e-9
+    q50 = parsed["samples"][
+        ("zoo_lat_seconds", (("model", "m"), ("quantile", "0.5")))]
+    assert abs(q50 - 0.002) < 1e-9
+    assert summary_family("z", "", {}, LatencyWindow().snapshot()) is None
+
+
+# ------------------------------------------------------ profile hooks
+def test_profile_hooks_count_compiles_and_attach_span_events(
+        monkeypatch):
+    import jax
+
+    from analytics_zoo_tpu.observability import profile
+
+    handle = profile.install()
+    assert profile.install() is handle  # singleton while installed
+    try:
+        before = handle.snapshot()["compiles"]
+        tracer = Tracer()
+        with tracer.request("r") as span:
+            jax.jit(lambda x: x * 3.1)(jax.device_put(
+                np.ones((2, 2), np.float32)))
+        after = handle.snapshot()
+        assert after["compiles"] >= before + 1
+        assert after["compile_seconds"] > 0
+        d = tracer.recent()[-1]
+        assert any(e["name"] == "backend_compile" for e in d["events"])
+        profile.note_transfer("h2d")
+        profile.note_transfer("h2d")
+        assert handle.snapshot()["transfers"]["h2d"] >= 2
+        fams = {f.name: f for f in handle.families()}
+        assert fams["zoo_xla_compiles_total"].samples[0][1] >= 1
+        assert fams["zoo_live_buffers"].mtype == "gauge"
+        assert fams["zoo_live_buffers"].samples[0][1] >= 0
+    finally:
+        handle.close()
+    n = handle.snapshot()["compiles"]
+    jax.jit(lambda x: x - 7.7)(jax.device_put(
+        np.ones((3, 3), np.float32)))
+    assert handle.snapshot()["compiles"] == n  # unhooked
+    assert profile.installed() is None
+    profile.note_transfer("h2d")  # no-op, must not raise
+
+
+def test_profile_attributes_coalesced_compile_to_rider_span():
+    """A compile triggered from the DISPATCHER thread (unwarmed
+    signature through the coalescer) must still land as a span event on
+    the request that paid it — the dispatcher has no contextvar, so the
+    cache activates the group's lead span around the cold dispatch."""
+    from analytics_zoo_tpu.observability import profile
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    handle = profile.install()
+    im = InferenceModel(supported_concurrent_num=2, max_batch_size=4,
+                        coalescing=True)
+    im.load_jax(lambda p, x: x * p["s"], {"s": np.float32(3.0)})
+    im.warmup((4,))  # warms float32 only
+    tracer = Tracer()
+    try:
+        with tracer.request("predict"):
+            im.predict(np.ones((2, 4), np.float16))  # unwarmed dtype
+        d = tracer.recent()[-1]
+        assert any(e["name"] == "backend_compile" for e in d["events"]), \
+            d["events"]
+        # d2h fetches count too (coalesced fetch path)
+        assert handle.snapshot()["transfers"].get("d2h", 0) >= 1
+    finally:
+        im.close()
+        handle.close()
+
+
+# -------------------------------------------------- end-to-end traced
+def test_traced_coalesced_predict_has_full_phase_chain():
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    im = InferenceModel(supported_concurrent_num=4, max_batch_size=8,
+                        coalescing=True)
+    im.load_jax(lambda p, x: x @ p["w"],
+                {"w": np.eye(4, dtype=np.float32)})
+    im.warmup((4,))
+    tracer = Tracer()
+    try:
+        # untraced predict takes the single-branch fast path
+        im.predict(np.ones((2, 4), np.float32))
+        assert tracer.span_count == 0
+        with tracer.request("predict") as span:
+            out = im.predict(np.ones((3, 4), np.float32))
+        assert out.shape == (3, 4)
+        d = tracer.recent()[0]
+        assert _phase_names(d) == ["coalesce_wait", "pad", "device_put",
+                                   "execute", "depad"]
+        assert all(p["dur_ms"] is not None for p in d["phases"])
+        for a, b in zip(d["phases"], d["phases"][1:]):
+            assert abs(a["start_ms"] + a["dur_ms"] - b["start_ms"]) < 1e-3
+        assert d["labels"]["bucket"] == 4
+    finally:
+        im.close()
+
+
+def test_traced_solo_and_exact_paths():
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    tracer = Tracer()
+    solo = InferenceModel(max_batch_size=8)  # bucketed, no coalescer
+    solo.load_jax(lambda p, x: x * p["s"], {"s": np.float32(2.0)})
+    solo.warmup((4,))
+    with tracer.request("predict"):
+        solo.predict(np.ones((2, 4), np.float32))
+    assert _phase_names(tracer.recent()[-1]) == \
+        ["pad", "device_put", "execute", "depad"]
+
+    exact = InferenceModel(bucketing=False)  # exact-shape path
+    exact.load_jax(lambda p, x: x + p["b"], {"b": np.float32(1.0)})
+    exact.predict(np.ones((2, 4), np.float32))  # warm the shape
+    with tracer.request("predict"):
+        exact.predict(np.ones((2, 4), np.float32))
+    assert _phase_names(tracer.recent()[-1]) == ["device_put", "execute"]
+    solo.close()
+    exact.close()
+
+
+def test_traced_oversized_batch_chunks_repeat_phases():
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    im = InferenceModel(max_batch_size=4)
+    im.load_jax(lambda p, x: x @ p["w"],
+                {"w": np.eye(3, dtype=np.float32)})
+    im.warmup((3,))
+    tracer = Tracer()
+    with tracer.request("predict"):
+        out = im.predict(np.ones((10, 3), np.float32))  # 3 chunks
+    assert out.shape == (10, 3)
+    d = tracer.recent()[0]
+    names = [p["name"] for p in d["phases"]]
+    assert names.count("execute") == 3
+    assert names.count("depad") == 3
+    im.close()
+
+
+def test_registry_traced_request_and_metric_satellites():
+    import datetime
+
+    from analytics_zoo_tpu.serving import (ModelRegistry,
+                                           registry_families)
+
+    tracer = Tracer()
+    reg = ModelRegistry(tracer=tracer, coalescing=True)
+    try:
+        reg.deploy("m", jax_fn=lambda p, x: x @ p["w"],
+                   params={"w": np.eye(4, dtype=np.float32)},
+                   warmup_shapes=(4,))
+        out, info = reg.predict_ex("m", np.ones((2, 4), np.float32),
+                                   trace_id="rid-1")
+        assert info["request_id"] == "rid-1"
+        d = tracer.find("rid-1")
+        assert _phase_names(d) == ["admission_queue", "coalesce_wait",
+                                   "pad", "device_put", "execute",
+                                   "depad"]
+        assert d["labels"]["model"] == "m"
+        assert d["labels"]["version"] == 1
+
+        m = reg.metrics()["m"]
+        # satellites: ISO-8601 deploy stamp, uptime gauge, canary frac
+        v1 = m["versions"][1]
+        parsed = datetime.datetime.fromisoformat(v1["deployed_at"])
+        assert parsed.tzinfo is not None
+        assert v1["uptime_s"] >= 0
+        assert m["canary_fraction"] == 0.0
+        reg.deploy("m", jax_fn=lambda p, x: x @ p["w"],
+                   params={"w": np.eye(4, dtype=np.float32) * 2},
+                   canary_fraction=0.25)
+        assert reg.metrics()["m"]["canary_fraction"] == 0.25
+
+        # exposition: per-model/version labels survive the round trip
+        fams = registry_families(reg.metrics())
+        parsed = parse_prometheus_text(render_prometheus(fams))
+        # counters carry only immutable labels (state would fork the
+        # series on promote/swap); state rides the info gauge instead
+        key = ("zoo_model_requests_total",
+               (("model", "m"), ("version", "1")))
+        assert parsed["samples"][key] == 1.0
+        assert parsed["samples"][
+            ("zoo_model_version_state",
+             (("model", "m"), ("state", "active"),
+              ("version", "1")))] == 1.0
+        assert parsed["samples"][
+            ("zoo_model_canary_fraction", (("model", "m"),))] == 0.25
+        assert any(k[0] == "zoo_model_uptime_seconds"
+                   for k in parsed["samples"])
+        assert any(k[0] == "zoo_bucket_misses_total"
+                   and dict(k[1])["bucket"] for k in parsed["samples"])
+    finally:
+        reg.shutdown()
+
+
+def test_shed_request_span_is_finished_with_error_label():
+    from analytics_zoo_tpu.serving import DeadlineExceeded, ModelRegistry
+
+    tracer = Tracer()
+    reg = ModelRegistry(tracer=tracer, coalescing=False)
+    try:
+        reg.deploy("m", jax_fn=lambda p, x: x * p["s"],
+                   params={"s": np.float32(1.0)}, warmup_shapes=(4,))
+        reg.predict("m", np.ones((1, 4), np.float32))  # seed the EWMA
+        with pytest.raises(DeadlineExceeded):
+            reg.predict_ex("m", np.ones((1, 4), np.float32),
+                           deadline_ms=0.0001, trace_id="shed-1")
+        d = tracer.find("shed-1")
+        assert d is not None
+        assert d["labels"]["error"] == "DeadlineExceeded"
+        assert all(p["dur_ms"] is not None for p in d["phases"])
+    finally:
+        reg.shutdown()

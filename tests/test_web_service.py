@@ -27,9 +27,9 @@ def web_service_mod():
     return mod
 
 
-def _serve(mod, registry):
+def _serve(mod, registry, obs=None):
     server = ThreadingHTTPServer(("127.0.0.1", 0),
-                                 mod.make_handler(registry))
+                                 mod.make_handler(registry, obs))
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server, server.server_address[1]
@@ -46,15 +46,17 @@ def _post(port, path, payload):
 def test_self_test_in_process_hot_swap_zero_failures(web_service_mod):
     """The app's own --self-test, run in-process: 8 concurrent clients,
     a hot-swap mid-traffic, zero failed requests, both versions
-    observed, /metrics coherent."""
+    observed, /metrics coherent, a traced request's phases covering
+    its span wall, and the Prometheus exposition round-tripping."""
     mod = web_service_mod
-    registry = mod.build_registry()
-    server, port = _serve(mod, registry)
+    registry, obs = mod.build_registry()
+    server, port = _serve(mod, registry, obs)
     try:
         mod.self_test(port)  # asserts internally
     finally:
         server.shutdown()
         registry.shutdown()
+        obs["profile"].close()
 
 
 def test_structured_error_surface(web_service_mod):
@@ -104,8 +106,8 @@ def test_structured_error_surface(web_service_mod):
 
 def test_deploy_and_canary_over_http(web_service_mod):
     mod = web_service_mod
-    registry = mod.build_registry()
-    server, port = _serve(mod, registry)
+    registry, obs = mod.build_registry()
+    server, port = _serve(mod, registry, obs)
     x = np.zeros((2, mod.N_FEATURES), np.float32).tolist()
     try:
         out = _post(port, "/predict", {"instances": x})
@@ -128,3 +130,73 @@ def test_deploy_and_canary_over_http(web_service_mod):
     finally:
         server.shutdown()
         registry.shutdown()
+        obs["profile"].close()
+
+
+def test_observability_surface_over_http(web_service_mod):
+    """X-Request-Id response header, /traces ring buffer + by-id
+    lookup, and the Prometheus exposition round-tripping with
+    model/version/bucket labels."""
+    from analytics_zoo_tpu.observability import parse_prometheus_text
+
+    mod = web_service_mod
+    registry, obs = mod.build_registry()
+    server, port = _serve(mod, registry, obs)
+    x = np.zeros((3, mod.N_FEATURES), np.float32).tolist()
+    try:
+        req = Request(f"http://127.0.0.1:{port}/predict",
+                      data=json.dumps({"instances": x}).encode(),
+                      headers={"Content-Type": "application/json",
+                               "X-Request-Id": "req-test-0001"})
+        with urlopen(req, timeout=30) as resp:
+            assert resp.headers["X-Request-Id"] == "req-test-0001"
+            out = json.loads(resp.read())
+        assert out["request_id"] == "req-test-0001"
+
+        with urlopen(f"http://127.0.0.1:{port}/traces?id=req-test-0001",
+                     timeout=30) as r:
+            tr = json.loads(r.read())
+        names = [p["name"] for p in tr["phases"]]
+        assert names[0] == "admission_queue"
+        assert {"pad", "device_put", "execute", "depad"} <= set(names)
+        assert all(p["dur_ms"] is not None for p in tr["phases"])
+        assert tr["labels"] == {"model": mod.DEFAULT_MODEL,
+                                "version": 1, "bucket": 4}
+
+        with urlopen(f"http://127.0.0.1:{port}/traces", timeout=30) as r:
+            ring = json.loads(r.read())
+        assert ring["span_count"] >= 1
+        assert any(t["trace_id"] == "req-test-0001"
+                   for t in ring["traces"])
+        assert "execute" in ring["phase_stats"]
+
+        # unknown id -> structured 404
+        with pytest.raises(HTTPError) as ei:
+            urlopen(f"http://127.0.0.1:{port}/traces?id=nope",
+                    timeout=30)
+        assert ei.value.code == 404
+
+        # malformed query -> structured 400, not a dropped connection
+        with pytest.raises(HTTPError) as ei:
+            urlopen(f"http://127.0.0.1:{port}/traces?n=abc", timeout=30)
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["error"] == "ValueError"
+
+        with urlopen(
+                f"http://127.0.0.1:{port}/metrics?format=prometheus",
+                timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            parsed = parse_prometheus_text(r.read().decode())
+        samples = parsed["samples"]
+        assert samples[("zoo_model_requests_total",
+                        (("model", mod.DEFAULT_MODEL),
+                         ("version", "1")))] >= 1
+        bucket_keys = [k for k in samples
+                       if k[0] == "zoo_bucket_hits_total"
+                       or k[0] == "zoo_bucket_misses_total"]
+        assert any(dict(k[1]).get("bucket") for k in bucket_keys)
+        assert parsed["types"]["zoo_live_buffers"] == "gauge"
+    finally:
+        server.shutdown()
+        registry.shutdown()
+        obs["profile"].close()
