@@ -386,17 +386,18 @@ class KerasNet(Layer):
     def to_serving(self, supported_concurrent_num: int = 1,
                    max_batch_size: int = 32, coalescing: bool = False,
                    max_wait_ms: float = 2.0, quantize: Optional[bool] = None,
-                   warmup_shapes=None):
+                   warmup_shapes=None, replicas=1):
         """Wrap this net in an ``InferenceModel`` on the serving fast
         path (shape-bucketed executable cache; optional request
-        coalescing — see docs/serving.md).  ``warmup_shapes`` (a
+        coalescing; ``replicas="all"`` places the executables on every
+        local device — see docs/serving.md).  ``warmup_shapes`` (a
         per-sample shape, or list of them for multi-input) AOT-compiles
         the whole bucket ladder before traffic arrives."""
         from ....pipeline.inference import InferenceModel
         im = InferenceModel(
             supported_concurrent_num=supported_concurrent_num,
             max_batch_size=max_batch_size, coalescing=coalescing,
-            max_wait_ms=max_wait_ms)
+            max_wait_ms=max_wait_ms, replicas=replicas)
         im.load_keras_net(self, quantize=quantize)
         if warmup_shapes is not None and im._cache is not None:
             # quantized handles serve on the exact-shape path (no

@@ -1,3 +1,3 @@
 from .inference_model import InferenceModel, AbstractInferenceModel, JTensor
 from .serving import (BucketedExecutableCache, CoalescerClosedError,
-                      RequestCoalescer, bucket_ladder)
+                      Replica, ReplicaSet, RequestCoalescer, bucket_ladder)

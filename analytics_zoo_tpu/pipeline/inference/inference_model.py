@@ -23,7 +23,7 @@ import jax
 from ...observability import profile as _profile
 from ...observability import trace as _trace
 from .serving import (BucketedExecutableCache, CoalescerClosedError,
-                      RequestCoalescer, _rows)
+                      ReplicaSet, RequestCoalescer, _rows)
 
 
 class JTensor:
@@ -64,9 +64,12 @@ class InferenceModel:
                  bucket_growth: float = 2.0,
                  bucketing: bool = True,
                  coalescing: bool = False,
-                 max_wait_ms: float = 2.0):
+                 max_wait_ms: float = 2.0,
+                 replicas=1):
         """``supported_concurrent_num`` bounds concurrent device work
-        (reference semantics).  The serving fast path adds:
+        (reference semantics; PER REPLICA when replicated — the
+        effective bound scales with the replica count).  The serving
+        fast path adds:
 
         * ``bucketing`` — pad each batch up to a geometric ladder of
           batch sizes (1, 2, … ``max_batch_size`` scaled by
@@ -80,9 +83,19 @@ class InferenceModel:
           dispatch (amortizing the ~4-8 ms dispatch floor), waiting at
           most ``max_wait_ms`` to fill ``max_batch_size`` rows; results
           fan back out bit-identical to solo runs.
+        * ``replicas`` — ``"all"`` or an int N: place each bucket
+          executable on that many local devices (compiled ONCE,
+          serialized, loaded per device — see
+          :class:`~.serving.ReplicaSet`), params copied per device, and
+          route dispatches across the replicas.  Clamped to the local
+          device count; 1 (the default) keeps the single-device path.
+          Quantized handles stay single-device (their exact-shape path
+          has no bucket executables to replicate).
         """
         self.concurrent_num = int(supported_concurrent_num)
         self._semaphore = threading.Semaphore(self.concurrent_num)
+        self._sem_capacity = self.concurrent_num
+        self._replicas_req = replicas
         self._predict_fn = None
         self._params = None
         self._state = None
@@ -175,7 +188,12 @@ class InferenceModel:
         # measurable against the per-dispatch floor
         params_dev = self._params
         predict_fn = jax.jit(lambda x: fn(params_dev, x))
-        self._install(predict_fn)
+        # hand the PLACED tree to the replica path: device_put of an
+        # array already committed to the target device is a no-op, so
+        # replica 0 shares the closure's buffers instead of pinning a
+        # second copy of the weights in device-0 memory
+        self._install(predict_fn, replica_fn=fn,
+                      replica_params=self._params)
         return self
 
     def _attach(self, graph, params, state):
@@ -191,14 +209,38 @@ class InferenceModel:
             out, _ = graph.apply(params, state, x, training=False)
             return out
 
-        self._install(predict_fn)
+        def replica_fn(bundle, x):
+            # the replica path needs the weights as an ARGUMENT (placed
+            # per device by the ReplicaSet), not a closure constant
+            out, _ = graph.apply(bundle["params"], bundle["state"], x,
+                                 training=False)
+            return out
 
-    def _install(self, predict_fn):
+        self._install(predict_fn, replica_fn=replica_fn,
+                      replica_params={"params": params, "state": state})
+
+    def _resolve_replicas(self) -> int:
+        """The effective replica count: the request ("all" or an int),
+        clamped to the local device count."""
+        req = self._replicas_req
+        avail = len(jax.local_devices())
+        if isinstance(req, str):
+            if req.lower() != "all":
+                raise ValueError(
+                    f'replicas must be "all" or an int, got {req!r}')
+            return avail
+        n = int(req)
+        if n < 1:
+            raise ValueError(f"replicas must be >= 1, got {n}")
+        return min(n, avail)
+
+    def _install(self, predict_fn, replica_fn=None, replica_params=None):
         """Install the forward and (re)build the serving fast path for
-        it: bucketed executable cache + optional coalescer.  Quantized
-        handles stay on the exact-shape path — their dynamic activation
-        scales are batch-global, so padded filler rows would change
-        real-row outputs.
+        it: bucketed executable cache (optionally replicated across
+        local devices) + optional coalescer.  Quantized handles stay on
+        the exact-shape path — their dynamic activation scales are
+        batch-global, so padded filler rows would change real-row
+        outputs.
 
         Reload ordering (the zero-downtime contract): the NEW path is
         fully built and published first, THEN the old coalescer is
@@ -208,18 +250,38 @@ class InferenceModel:
         old_coalescer = self._coalescer
         cache = None
         coalescer = None
+        replica_set = None
         if self._bucketing and not getattr(self, "_quantize_flag", False):
+            n_rep = self._resolve_replicas()
+            if n_rep > 1 and replica_fn is not None:
+                replica_set = ReplicaSet(
+                    replica_fn, replica_params,
+                    devices=jax.local_devices()[:n_rep])
             cache = BucketedExecutableCache(
                 predict_fn, max_batch=self.max_batch_size,
-                buckets=self._buckets, growth=self._bucket_growth)
-            if self._coalescing:
-                # pipeline two dispatches when the concurrency budget
-                # allows — the device computes group k while group k+1
-                # is gathered and dispatched behind it
-                coalescer = RequestCoalescer(
-                    cache, max_wait_ms=self.max_wait_ms,
-                    semaphore=self._semaphore,
-                    pipeline_depth=min(2, self.concurrent_num))
+                buckets=self._buckets, growth=self._bucket_growth,
+                replica_set=replica_set)
+        # the concurrency budget is per replica: N devices can carry N
+        # times the concurrent device work of one.  The semaphore is
+        # REUSED when the capacity is unchanged: a reload under traffic
+        # must keep old-path drains and new-path traffic on one shared
+        # budget (a fresh semaphore would let them stack to 2x during
+        # the drain window).  Only a genuine capacity change — the
+        # replica count moved — warrants a new budget.
+        n_active = replica_set.n if replica_set is not None else 1
+        cap = self.concurrent_num * n_active
+        if cap != self._sem_capacity:
+            self._semaphore = threading.Semaphore(cap)
+            self._sem_capacity = cap
+        if cache is not None and self._coalescing:
+            # pipeline two dispatches when the concurrency budget
+            # allows — the device computes group k while group k+1
+            # is gathered and dispatched behind it.  (The coalescer
+            # widens this to one slot per replica when replicated.)
+            coalescer = RequestCoalescer(
+                cache, max_wait_ms=self.max_wait_ms,
+                semaphore=self._semaphore,
+                pipeline_depth=min(2, self.concurrent_num))
         # one assignment publishes the whole new path (GIL-atomic)
         self._fastpath = (predict_fn, cache, coalescer)
         self._predict_fn = predict_fn
@@ -230,6 +292,17 @@ class InferenceModel:
             # executables; anything racing the shutdown gets
             # CoalescerClosedError and the caller falls back
             old_coalescer.close()
+
+    @property
+    def n_replicas(self) -> int:
+        """Active replica count (1 on the single-device path)."""
+        fastpath = self._fastpath
+        if fastpath is None:
+            return 1
+        _, cache, _ = fastpath
+        if cache is None or cache.replica_set is None:
+            return 1
+        return cache.replica_set.n
 
     # ---- serving fast path surface ----
     def warmup(self, sample_shapes, dtypes=None) -> float:
@@ -251,7 +324,8 @@ class InferenceModel:
         the serving control plane's metrics snapshot)."""
         out = {"buckets": (), "hits": {}, "misses": {},
                "compile_time_s": {}, "dispatches": 0,
-               "coalesced_requests": 0, "coalescer_pending": 0}
+               "coalesced_requests": 0, "coalescer_pending": 0,
+               "replicas": 1}
         # snapshot the triple so a metrics read during reload() never
         # pairs the new cache's counters with the old coalescer's
         fastpath = self._fastpath
@@ -261,6 +335,8 @@ class InferenceModel:
         if cache is not None:
             out["buckets"] = cache.buckets
             out.update(cache.stats.snapshot())
+            if cache.replica_set is not None:
+                out.update(cache.replica_set.stats())
         if coalescer is not None:
             out["dispatches"] = coalescer.dispatches
             out["coalesced_requests"] = coalescer.coalesced_requests

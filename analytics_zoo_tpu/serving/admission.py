@@ -165,6 +165,20 @@ class AdmissionController:
                     dt - self._service_ewma_s)
             self._cond.notify_all()
 
+    def set_max_concurrency(self, n: int):
+        """Re-bound concurrent service (thread-safe).  The registry
+        calls this when a deployed model's replica count changes — N
+        device replicas carry N times the concurrent work of one, so
+        the admission bound scales with them.  Raising the bound wakes
+        queued waiters immediately; lowering it only throttles NEW
+        admissions (requests already running finish normally)."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"max_concurrency must be >= 1, got {n}")
+        with self._cond:
+            self.max_concurrency = n
+            self._cond.notify_all()
+
     # ---- shutdown ----
     def drain(self, timeout: float = 10.0) -> bool:
         """Graceful shutdown: stop admitting NEW requests (they get

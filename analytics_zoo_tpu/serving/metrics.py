@@ -53,6 +53,14 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
         "zoo_bucket_misses_total": [],
         "zoo_bucket_compile_seconds_total": [],
     }
+    replica_counters: Dict[str, List] = {
+        "zoo_replica_dispatches_total": [],
+        "zoo_replica_bucket_dispatches_total": [],
+    }
+    replica_gauges: Dict[str, List] = {
+        "zoo_replica_unhealthy": [],
+        "zoo_model_replicas": [],
+    }
     coalescer_counters: Dict[str, List] = {
         "zoo_coalescer_dispatches_total": [],
         "zoo_coalesced_requests_total": [],
@@ -118,6 +126,27 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
         if "coalescer_pending" in serving:
             model_gauges["zoo_coalescer_pending"].append(
                 (ml, serving["coalescer_pending"]))
+        # device-parallel serving: per-replica dispatch counters (and
+        # their per-bucket breakdown — the bucket metrics' replica
+        # label) plus the health gauge
+        if serving.get("replica_dispatches"):
+            replica_gauges["zoo_model_replicas"].append(
+                (ml, serving.get("replicas", 1)))
+            for rep, v in sorted(serving["replica_dispatches"].items()):
+                replica_counters["zoo_replica_dispatches_total"].append(
+                    ({"model": model, "replica": str(rep)}, v))
+            for rep, sick in sorted(
+                    serving.get("replica_unhealthy", {}).items()):
+                replica_gauges["zoo_replica_unhealthy"].append(
+                    ({"model": model, "replica": str(rep)},
+                     1 if sick else 0))
+            for rep, per_bucket in sorted(
+                    serving.get("replica_bucket_dispatches", {}).items()):
+                for bucket, v in sorted(per_bucket.items()):
+                    replica_counters[
+                        "zoo_replica_bucket_dispatches_total"].append(
+                        ({"model": model, "replica": str(rep),
+                          "bucket": str(bucket)}, v))
 
     help_text = {
         "zoo_model_active_version": "active (serving) version number",
@@ -140,13 +169,22 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
         "zoo_coalescer_dispatches_total": "coalesced device dispatches",
         "zoo_coalesced_requests_total":
             "requests served through coalesced dispatches",
+        "zoo_model_replicas": "device replicas serving this model",
+        "zoo_replica_dispatches_total":
+            "device dispatches executed per replica",
+        "zoo_replica_bucket_dispatches_total":
+            "device dispatches per (replica, bucket)",
+        "zoo_replica_unhealthy":
+            "1 when the replica was marked unhealthy by a failed "
+            "dispatch",
     }
     out: List[Family] = []
-    gauge_groups = (model_gauges, version_gauges,
+    gauge_groups = (model_gauges, version_gauges, replica_gauges,
                     {k: v for k, v in admission.items()
                      if not k.endswith("_total")})
     counter_groups = (model_counters, version_counters,
                       bucket_counters, coalescer_counters,
+                      replica_counters,
                       {k: v for k, v in admission.items()
                        if k.endswith("_total")})
     for groups, mtype in ((gauge_groups, "gauge"),

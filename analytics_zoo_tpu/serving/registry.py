@@ -16,7 +16,10 @@ Deploy protocol (the zero-downtime contract)::
    the live version's executables are never touched;
 2. ``warmup()`` AOT-compiles the new version's whole bucket ladder TO
    COMPLETION while the old version keeps serving — live traffic never
-   pays a trace;
+   pays a trace.  A replicated model (``replicas=``) compiles each
+   bucket ONCE and places + primes the executable on EVERY replica
+   before the swap, and the model's admission concurrency is re-scaled
+   to ``max_concurrency * replicas``;
 3. the active-version pointer is swapped atomically (one reference
    assignment; every request reads it exactly once, so each response is
    computed ENTIRELY by the old or entirely by the new version);
@@ -261,6 +264,7 @@ class ModelRegistry:
                         old = entry.active
                         dep.state = "active"
                         entry.active = dep  # THE swap: one assignment
+                        self._scale_admission(entry, dep)
                         if old is not None:
                             entry.swap_count += 1
             if stale:
@@ -271,6 +275,16 @@ class ModelRegistry:
                     "version was discarded", model=name, version=version)
             self._retire(entry, old)
         return version
+
+    def _scale_admission(self, entry: _Entry, dep: _Deployment):
+        """Admission concurrency follows the ACTIVE version's replica
+        count: N device replicas carry N times the concurrent work, so
+        the per-model bound is base * replicas (reset to base when an
+        un-replicated version activates).  Only activation re-scales —
+        a staged canary must not re-bound the traffic the active
+        version is still serving."""
+        reps = getattr(dep.model, "n_replicas", 1) or 1
+        entry.admission.set_max_concurrency(self._max_concurrency * reps)
 
     def promote(self, name: str) -> int:
         """Make the staged canary the active version (atomic swap,
@@ -286,6 +300,7 @@ class ModelRegistry:
             entry.active = dep
             entry.canary = None
             entry.canary_fraction = 0.0
+            self._scale_admission(entry, dep)
             if old is not None:
                 entry.swap_count += 1
         self._retire(entry, old)

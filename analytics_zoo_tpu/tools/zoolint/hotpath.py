@@ -23,7 +23,11 @@ direction for a lint — the baseline absorbs the justified hits.
 
 Hot entry points are matched by FINAL name so the rule follows renames
 and new implementations: ``predict``, ``predict_ex``, ``_loop`` (the
-coalescer dispatcher), ``submit``, and ``dispatch_padded``.
+coalescer dispatcher), ``submit``, ``dispatch_padded``, plus the
+multi-replica scheduler loop's own pieces — ``dispatch`` (the
+ReplicaSet per-replica dispatch) and ``pack`` (the staging arena fill,
+dispatcher-thread hot) — so ZL301/302/601 cover the device-parallel
+path even if the coalescer loop is later refactored around it.
 """
 
 from __future__ import annotations
@@ -35,7 +39,7 @@ from .context import ModuleContext, QualnameVisitor, last_name
 from .findings import Finding
 
 DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
-                       "dispatch_padded")
+                       "dispatch_padded", "dispatch", "pack")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

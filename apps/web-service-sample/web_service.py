@@ -82,9 +82,15 @@ def build_registry():
     from analytics_zoo_tpu.serving import ModelRegistry, registry_collector
 
     tracer = Tracer(capacity=TRACE_RING)
+    # replicas="all": every local device serves — on a multi-chip host
+    # each chip holds the executables + params and the coalescer
+    # schedules groups across them (run the self-test under
+    # XLA_FLAGS=--xla_force_host_platform_device_count=N to see it on
+    # CPU; scripts/smoke_serving.sh forces 2)
     registry = ModelRegistry(max_queue=64, max_concurrency=4,
                              supported_concurrent_num=4,
                              max_batch_size=32, coalescing=True,
+                             replicas="all",
                              tracer=tracer)
     metrics = MetricsRegistry()
     metrics.register_collector(registry_collector(registry))
@@ -300,6 +306,22 @@ def self_test(port: int):
     assert "T" in vstats["deployed_at"], vstats["deployed_at"]
     assert vstats["uptime_s"] >= 0
     assert m["canary_fraction"] == 0.0
+    # multi-replica serving: the new version is placed on every local
+    # device, every replica is healthy, and the swap's traffic spread
+    # across them (dispatch counts per replica are exported)
+    import jax
+    n_dev = len(jax.local_devices())
+    assert m["serving"]["replicas"] == n_dev, m["serving"]["replicas"]
+    if n_dev > 1:
+        rd = m["serving"]["replica_dispatches"]
+        assert len(rd) == n_dev and sum(rd.values()) > 0, rd
+        assert not any(m["serving"]["replica_unhealthy"].values()), \
+            m["serving"]["replica_unhealthy"]
+        # one compile per bucket even though every device serves
+        assert all(v == 1 for v in m["serving"]["misses"].values()), \
+            m["serving"]["misses"]
+        print(f"replica check: {n_dev} replicas, dispatches {rd}, "
+              "all healthy, one compile per bucket OK")
 
     # ---- tracing: one trace per request, phases account for the wall.
     # A big batch (chunked over the bucket ladder) makes device work
@@ -339,10 +361,14 @@ def self_test(port: int):
         text = resp.read().decode()
     parsed = parse_prometheus_text(text)  # raises on any bad line
     names = {k[0] for k in parsed["samples"]}
-    for required in ("zoo_model_requests_total", "zoo_bucket_hits_total",
-                     "zoo_trace_spans_total", "zoo_xla_compiles_total",
-                     "zoo_admission_completed_total"):
-        assert required in names, f"{required} missing from exposition"
+    required = ["zoo_model_requests_total", "zoo_bucket_hits_total",
+                "zoo_trace_spans_total", "zoo_xla_compiles_total",
+                "zoo_admission_completed_total"]
+    if n_dev > 1:
+        required += ["zoo_replica_dispatches_total",
+                     "zoo_replica_unhealthy", "zoo_model_replicas"]
+    for name in required:
+        assert name in names, f"{name} missing from exposition"
     labeled = [k for k in parsed["samples"]
                if k[0] == "zoo_model_requests_total"]
     assert any(dict(k[1]).get("model") == DEFAULT_MODEL

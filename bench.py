@@ -1415,6 +1415,164 @@ def _bench_registry(mlp, params, d_in, max_batch, max_wait_ms,
     return out, ok
 
 
+def _bench_replicas(mlp, params, d_in, max_batch, max_wait_ms,
+                    n_requests, selfcheck):
+    """Multi-replica serving: 1-replica vs N-replica (forced host
+    devices) throughput at c=32, INTERLEAVED within one run per the
+    house methodology (each worker alternates models per request, so
+    scheduler drift hits both populations identically — two separate
+    runs differ ±30% on this box on noise alone).
+
+    Gates (selfcheck, deterministic mechanisms only): dispatch balance
+    across replicas max/min <= 2 at c=32; exactly ONE compile per
+    (model, bucket) even with every replica placed; a sanitize-clean
+    warmed loop (0 compiles, 0 implicit transfers) that touches every
+    replica.  The throughput ratio stays INFORMATIONAL: on the 2-core
+    box N forced host devices share 2 cores, so the replica win is
+    structural (pipelining), not a CPU speedup (perf-flake policy)."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    n_dev = len(jax.local_devices())
+    if n_dev < 2:
+        _log("serving replicas: <2 local devices, section skipped "
+             "(run under XLA_FLAGS=--xla_force_host_platform_"
+             "device_count=4)")
+        return {"skipped": f"{n_dev} device(s)"}, True
+
+    n_rep = min(4, n_dev)
+    rng = np.random.default_rng(7)
+    requests = [rng.normal(size=(1, d_in)).astype(np.float32)
+                for _ in range(32)]
+
+    def make(replicas):
+        im = InferenceModel(supported_concurrent_num=4,
+                            max_batch_size=max_batch, coalescing=True,
+                            max_wait_ms=max_wait_ms, replicas=replicas)
+        im.load_jax(mlp, params)
+        im.warmup((d_in,))
+        return im
+
+    im1, imN = make(1), make(n_rep)
+    results = {"devices": n_dev, "replicas": imN.n_replicas}
+    ok = True
+
+    # ---- interleaved 1-vs-N throughput at c=32 (informational) ----
+    d0 = {k: v for k, v in
+          imN.serving_stats()["replica_dispatches"].items()}
+    lat1: list = []
+    latN: list = []
+    lock = threading.Lock()
+    per_thread = max(4, n_requests // 32)
+
+    def worker(tid):
+        mine1, mineN = [], []
+        for k in range(per_thread):
+            x = requests[(tid + k) % len(requests)]
+            t0 = time.perf_counter()
+            if k % 2:
+                imN.predict(x)
+                mineN.append(time.perf_counter() - t0)
+            else:
+                im1.predict(x)
+                mine1.append(time.perf_counter() - t0)
+        with lock:
+            lat1.extend(mine1)
+            latN.extend(mineN)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(32)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+
+    def trimmed_rps(lat):
+        if not lat:
+            return 0.0
+        lat = sorted(lat)[:max(1, int(len(lat) * 0.95))]
+        return len(lat) / sum(lat)
+
+    r1, rN = trimmed_rps(lat1), trimmed_rps(latN)
+    ratio = round(rN / max(r1, 1e-9), 3)
+    results.update(single_rps=round(r1, 1), multi_rps=round(rN, 1),
+                   interleaved_ratio=ratio)
+    _log(f"serving replicas c=32 interleaved: 1-replica {r1:.1f} rps, "
+         f"{imN.n_replicas}-replica {rN:.1f} rps, ratio {ratio}x "
+         f"(informational on this box)")
+
+    # ---- balance gate: dispatches per replica over the run ----
+    stats = imN.serving_stats()
+    delta = {k: v - d0.get(k, 0)
+             for k, v in stats["replica_dispatches"].items()}
+    results["replica_dispatches"] = delta
+    lo, hi = min(delta.values()), max(delta.values())
+    balance = round(hi / max(lo, 1e-9), 2) if lo else float("inf")
+    results["balance_max_min"] = (balance if lo else None)
+    _log(f"serving replicas balance: dispatches {delta} "
+         f"(max/min {balance if lo else 'inf'})")
+    if selfcheck and (lo == 0 or balance > 2.0):
+        _log(f"serving replicas selfcheck FAIL: dispatch balance "
+             f"max/min {balance if lo else 'inf'} > 2 at c=32: {delta}")
+        ok = False
+
+    # ---- one compile per (model, bucket), N replicas placed ----
+    for name, im in (("1-replica", im1),
+                     (f"{imN.n_replicas}-replica", imN)):
+        misses = im.serving_stats()["misses"]
+        results[f"misses_{im.n_replicas}"] = misses
+        if selfcheck and any(v != 1 for v in misses.values()):
+            _log(f"serving replicas selfcheck FAIL: {name} compiled a "
+                 f"bucket more than once: {misses}")
+            ok = False
+
+    # ---- sanitize: warmed loop clean on EVERY replica ----
+    from analytics_zoo_tpu.tools.zoolint import sanitize
+    san = {"clean": False, "all_replicas": False, "error": None}
+    s0 = dict(imN.serving_stats()["replica_dispatches"])
+    try:
+        with sanitize(max_compiles=0) as rep:
+            errs = []
+
+            def san_worker(tid):
+                try:
+                    for k in range(12):
+                        imN.predict(requests[(tid + k) % len(requests)])
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+
+            ths = [threading.Thread(target=san_worker, args=(i,))
+                   for i in range(16)]
+            [t.start() for t in ths]
+            [t.join() for t in ths]
+            if errs:
+                raise RuntimeError(errs[0])
+        s1 = imN.serving_stats()["replica_dispatches"]
+        touched = {k: s1[k] - s0.get(k, 0) for k in s1}
+        san.update(clean=True, compiles=rep.compiles,
+                   dispatches=touched,
+                   all_replicas=all(v > 0 for v in touched.values()))
+        _log(f"serving replicas sanitize: clean, per-replica "
+             f"dispatches {touched}")
+        if selfcheck and not san["all_replicas"]:
+            _log("serving replicas selfcheck FAIL: sanitize loop left "
+                 f"a replica idle: {touched}")
+            ok = False
+    except Exception as e:  # recompile or transfer-guard violation
+        san["error"] = f"{type(e).__name__}: {e}"
+        _log(f"serving replicas selfcheck FAIL: sanitize violation on "
+             f"the multi-replica hot loop: {san['error']}")
+        ok = False
+    results["sanitize"] = san
+    results["replica_unhealthy"] = \
+        imN.serving_stats()["replica_unhealthy"]
+    im1.close()
+    imN.close()
+    return results, ok
+
+
 def serving_bench(n_requests: int = 400, d_in: int = 64, d_hidden: int = 64,
                   n_layers: int = 192, max_batch: int = 32,
                   concurrencies=(1, 8, 32), max_wait_ms: float = 20.0,
@@ -1735,6 +1893,12 @@ def serving_bench(n_requests: int = 400, d_in: int = 64, d_hidden: int = 64,
         results["observability"] = obs
     coal_im.close()
     solo_im.close()
+    # ---- multi-replica: device-parallel dispatch (ISSUE 5) ----
+    rep_results, rep_ok = _bench_replicas(
+        mlp, params, d_in, max_batch, max_wait_ms, n_requests, selfcheck)
+    results["replicas"] = rep_results
+    if selfcheck and not rep_ok:
+        ok = False
     # ---- control plane: hot-swap blip + shed rate (ISSUE 2) ----
     reg_results, reg_ok = _bench_registry(
         mlp, params, d_in, max_batch, max_wait_ms, selfcheck)
@@ -1761,6 +1925,14 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "--selftest":
         sys.exit(selftest())
     elif len(sys.argv) > 1 and sys.argv[1] == "serving":
+        # the replicas section needs >1 device: force 4 virtual host
+        # devices BEFORE jax initializes (no-op when the caller already
+        # set a count; real-TPU runs see the board's own chips)
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
         out = None
         if "--out" in sys.argv:
             out = sys.argv[sys.argv.index("--out") + 1]

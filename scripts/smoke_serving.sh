@@ -11,9 +11,15 @@
 # Runnable standalone (like check_collection.sh) and cheap enough for
 # CI: one process, ~1 min on a cold CPU.  The timeout wrapper keeps a
 # wedged dispatcher/server from hanging the gate forever.
+#
+# Two forced host devices make the run MULTI-REPLICA end to end: the
+# registry deploys with replicas="all", so the self-test exercises the
+# compile-once/place-everywhere path, the cross-replica scheduler, and
+# the per-replica metrics — on plain CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 out=$(timeout -k 10 300 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
     python apps/web-service-sample/web_service.py --self-test)
 printf '%s\n' "$out"
 grep -q "prometheus scrape OK" <<<"$out" || {
@@ -22,6 +28,10 @@ grep -q "prometheus scrape OK" <<<"$out" || {
 }
 grep -q "trace check: " <<<"$out" || {
     echo "smoke FAIL: self-test never verified a request trace" >&2
+    exit 1
+}
+grep -q "replica check: 2 replicas" <<<"$out" || {
+    echo "smoke FAIL: self-test never verified multi-replica serving" >&2
     exit 1
 }
 echo "serving smoke OK"

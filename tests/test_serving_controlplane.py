@@ -125,6 +125,73 @@ def test_hot_swap_under_traffic_zero_failures_no_tearing():
         assert m["swap_count"] == 1
 
 
+def test_hot_swap_under_multi_replica_traffic_drains_every_replica():
+    """ISSUE 5 pin: a hot-swap while traffic spans FOUR device replicas
+    completes with zero failed and zero torn responses; the displaced
+    version's coalescer drains every replica's in-flight groups; the
+    new version arrives fully placed (one compile per bucket, every
+    replica healthy and primed) and admission re-scales with it."""
+    with ModelRegistry(max_concurrency=2, supported_concurrent_num=2,
+                       coalescing=True, max_wait_ms=1.0,
+                       max_batch_size=8, replicas=4) as reg:
+        _deploy_const(reg, "m", 1.0, warmup_shapes=(4,))
+        entry = reg._entry("m")
+        assert entry.admission.max_concurrency == 8  # 2 * 4 replicas
+        v1_model = entry.active.model
+        assert v1_model.n_replicas == 4
+        results, failures = [], []
+        lock = threading.Lock()
+        stop = threading.Event()
+        go = threading.Event()
+
+        def client():
+            go.wait()
+            x = np.zeros((2, 4), np.float32)
+            while not stop.is_set():
+                try:
+                    out = np.asarray(reg.predict("m", x))
+                    with lock:
+                        results.append(out)
+                except Exception as e:  # noqa: BLE001 — asserted empty
+                    with lock:
+                        failures.append(repr(e))
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        [t.start() for t in threads]
+        go.set()
+        try:
+            time.sleep(0.15)
+            _deploy_const(reg, "m", 2.0)  # swap mid-traffic
+            time.sleep(0.3)
+        finally:
+            stop.set()
+            [t.join() for t in threads]
+
+        assert not failures, failures[:5]
+        seen = set()
+        for out in results:
+            vals = np.unique(out)
+            assert vals.size == 1, f"torn response: {vals}"
+            seen.add(float(vals[0]))
+        assert seen == {1.0, 2.0}, seen
+        m = reg.metrics("m")["m"]
+        assert m["admission"]["errors"] == 0
+        assert m["swap_count"] == 1
+        # the displaced version drained: its coalescer is closed with
+        # nothing pending on any replica slot
+        assert v1_model._coalescer.closed
+        assert v1_model._coalescer.pending == 0
+        assert all(c == 0 for c in v1_model._coalescer._slot_inflight)
+        # the new version is fully placed and healthy on all replicas
+        serving = m["serving"]
+        assert serving["replicas"] == 4
+        assert all(v == 1 for v in serving["misses"].values()), serving
+        assert not any(serving["replica_unhealthy"].values())
+        v1_traffic = sum(1 for o in results if float(o.flat[0]) == 1.0)
+        v2_traffic = sum(1 for o in results if float(o.flat[0]) == 2.0)
+        assert v1_traffic and v2_traffic
+
+
 def test_warmup_failure_rolls_back_to_prior_version():
     with ModelRegistry() as reg:
         _deploy_const(reg, "m", 1.0, warmup_shapes=(3,))

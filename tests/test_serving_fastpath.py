@@ -358,6 +358,62 @@ def test_coalescer_crash_fails_queued_and_inflight_not_hang():
         c.submit(np.ones((1, 2), np.float32))
 
 
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_coalescer_crash_net_covers_multi_replica_inflight():
+    """Crash-net extension for device-parallel serving (ISSUE 5): the
+    dispatcher dying with a group in flight ON A REPLICA SLOT must fail
+    every waiter and release the slot accounting — same contract as the
+    single-device crash net, exercised through the 4-tuple in-flight
+    bookkeeping the replica scheduler added."""
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    im = InferenceModel(supported_concurrent_num=2, max_batch_size=2,
+                        coalescing=True, max_wait_ms=1.0, replicas=2)
+    im.load_jax(lambda p, x: x * p["s"], {"s": np.float32(1.0)})
+    im.warmup((2,))
+    c = im._coalescer
+    assert c._rs is not None and c._rs.n == 2
+
+    gate, entered = threading.Event(), threading.Event()
+    orig = c._cache.dispatch_padded
+
+    def blocking_dispatch(batched, spans=(), replica=None):
+        entered.set()
+        gate.wait(timeout=30)
+        return orig(batched, spans, replica=replica)
+
+    c._cache.dispatch_padded = blocking_dispatch  # instance attr shadow
+    f1 = c.submit(np.ones((1, 2), np.float32))
+    assert entered.wait(timeout=10)  # f1's group mid-dispatch on a slot
+
+    def bad_gather(*a, **k):
+        raise RuntimeError("injected dispatcher crash")
+
+    c._gather = bad_gather
+    f2 = c.submit(np.ones((1, 2), np.float32))
+    f3 = c.submit(np.ones((1, 2), np.float32))
+    gate.set()
+
+    for f in (f2, f3):
+        with pytest.raises(RuntimeError, match="injected"):
+            f.result(timeout=10)
+    try:
+        f1.result(timeout=10)  # resolved or crash-net-failed, never hung
+    except RuntimeError:
+        pass
+    c._thread.join(timeout=10)
+    assert not c._thread.is_alive()
+    assert c.pending == 0
+    with pytest.raises(CoalescerClosedError):
+        c.submit(np.ones((1, 2), np.float32))
+    # the crash returned every device-concurrency slot: the solo
+    # fallback path (which the model would now take) must not wedge
+    out = im._cache.run(np.ones((1, 2), np.float32),
+                        sem=im._semaphore)
+    np.testing.assert_array_equal(out, np.ones((1, 2), np.float32))
+
+
 def test_submit_after_dispatcher_exit_raises_not_hangs():
     """A dispatcher that exited (here: a sentinel injected directly,
     bypassing close()) leaves the coalescer refusing submits instead of

@@ -160,8 +160,13 @@ def test_observability_surface_over_http(web_service_mod):
         assert names[0] == "admission_queue"
         assert {"pad", "device_put", "execute", "depad"} <= set(names)
         assert all(p["dur_ms"] is not None for p in tr["phases"])
-        assert tr["labels"] == {"model": mod.DEFAULT_MODEL,
-                                "version": 1, "bucket": 4}
+        # replica: the app deploys with replicas="all", so the span
+        # also records which device replica executed the dispatch
+        labels = dict(tr["labels"])
+        replica = labels.pop("replica")
+        assert 0 <= replica < len(__import__("jax").local_devices())
+        assert labels == {"model": mod.DEFAULT_MODEL,
+                          "version": 1, "bucket": 4}
 
         with urlopen(f"http://127.0.0.1:{port}/traces", timeout=30) as r:
             ring = json.loads(r.read())
