@@ -58,6 +58,25 @@ class LatencyWindow:
             self._count += 1
             self._total_s += seconds
 
+    @property
+    def count(self) -> int:
+        """Total samples ever added (not just the current window)."""
+        with self._lock:
+            return self._count
+
+    def percentile(self, pct: float) -> Optional[float]:
+        """Nearest-rank percentile over the current window, in SECONDS
+        (None while empty).  The hedging threshold reads this directly
+        — a full ``snapshot()`` per dispatched group would sort the
+        window three times for two discarded quantiles."""
+        with self._lock:
+            data = sorted(self._samples)
+        if not data:
+            return None
+        k = min(len(data) - 1,
+                max(0, int(round((pct / 100.0) * (len(data) - 1)))))
+        return data[k]
+
     def snapshot(self) -> Dict[str, Optional[float]]:
         with self._lock:
             data = sorted(self._samples)

@@ -65,7 +65,10 @@ class InferenceModel:
                  bucketing: bool = True,
                  coalescing: bool = False,
                  max_wait_ms: float = 2.0,
-                 replicas=1):
+                 replicas=1,
+                 hedging: bool = False,
+                 hedge_quantile: float = 0.99,
+                 hedge_min_ms: float = 0.5):
         """``supported_concurrent_num`` bounds concurrent device work
         (reference semantics; PER REPLICA when replicated — the
         effective bound scales with the replica count).  The serving
@@ -91,6 +94,13 @@ class InferenceModel:
           device count; 1 (the default) keeps the single-device path.
           Quantized handles stay single-device (their exact-shape path
           has no bucket executables to replicate).
+        * ``hedging`` — p99 straggler mitigation (coalesced,
+          multi-replica only): a dispatched group whose in-flight time
+          exceeds the ``hedge_quantile`` of observed group latencies
+          (floored at ``hedge_min_ms``) is re-dispatched to a second
+          healthy replica and the first result wins — bit-exact either
+          way (same serialized executable on every replica).  No-ops
+          with fewer than 2 eligible replicas.
         """
         self.concurrent_num = int(supported_concurrent_num)
         self._semaphore = threading.Semaphore(self.concurrent_num)
@@ -106,6 +116,9 @@ class InferenceModel:
         self._bucketing = bool(bucketing)
         self._coalescing = bool(coalescing)
         self.max_wait_ms = float(max_wait_ms)
+        self._hedging = bool(hedging)
+        self._hedge_quantile = float(hedge_quantile)
+        self._hedge_min_ms = float(hedge_min_ms)
         self._cache: Optional[BucketedExecutableCache] = None
         self._coalescer: Optional[RequestCoalescer] = None
         # (predict_fn, cache, coalescer) published as ONE tuple: a
@@ -281,7 +294,10 @@ class InferenceModel:
             coalescer = RequestCoalescer(
                 cache, max_wait_ms=self.max_wait_ms,
                 semaphore=self._semaphore,
-                pipeline_depth=min(2, self.concurrent_num))
+                pipeline_depth=min(2, self.concurrent_num),
+                hedging=self._hedging,
+                hedge_quantile=self._hedge_quantile,
+                hedge_min_ms=self._hedge_min_ms)
         # one assignment publishes the whole new path (GIL-atomic)
         self._fastpath = (predict_fn, cache, coalescer)
         self._predict_fn = predict_fn
@@ -295,7 +311,7 @@ class InferenceModel:
 
     @property
     def n_replicas(self) -> int:
-        """Active replica count (1 on the single-device path)."""
+        """Total replica count (1 on the single-device path)."""
         fastpath = self._fastpath
         if fastpath is None:
             return 1
@@ -303,6 +319,31 @@ class InferenceModel:
         if cache is None or cache.replica_set is None:
             return 1
         return cache.replica_set.n
+
+    @property
+    def active_replicas(self) -> int:
+        """Replicas currently in the scheduled (elastic) set."""
+        fastpath = self._fastpath
+        if fastpath is None:
+            return 1
+        _, cache, _ = fastpath
+        if cache is None or cache.replica_set is None:
+            return 1
+        return cache.replica_set.n_active
+
+    def set_active_replicas(self, n: int) -> int:
+        """Resize the scheduled replica set (the autoscaler's lever) —
+        joining replicas are primed on every placed signature BEFORE
+        they take traffic, so a scale-up never serves cold and never
+        compiles.  Returns the resulting active count; no-ops (returns
+        1) on the single-device path."""
+        fastpath = self._fastpath
+        if fastpath is None:
+            raise RuntimeError("InferenceModel: no model loaded")
+        _, cache, _ = fastpath
+        if cache is None or cache.replica_set is None:
+            return 1
+        return cache.replica_set.set_active(n)
 
     # ---- serving fast path surface ----
     def warmup(self, sample_shapes, dtypes=None) -> float:
@@ -341,6 +382,8 @@ class InferenceModel:
             out["dispatches"] = coalescer.dispatches
             out["coalesced_requests"] = coalescer.coalesced_requests
             out["coalescer_pending"] = coalescer.pending
+            if coalescer.hedging:
+                out["hedges"] = coalescer.hedge_stats()
         return out
 
     def close(self):

@@ -41,7 +41,9 @@ import contextlib
 import queue
 import threading
 import time
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import (FIRST_COMPLETED, Future,
+                                ThreadPoolExecutor)
+from concurrent.futures import wait as _futures_wait
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -52,6 +54,7 @@ from ...common.utils import pad_leading as _pad_rows
 from ...observability import profile as _profile
 from ...observability import trace as _trace
 from ...observability.log import get_logger as _get_logger
+from ...observability.metrics import LatencyWindow as _LatencyWindow
 
 _slog = _get_logger("zoo.serving")
 
@@ -125,10 +128,17 @@ class Replica:
     """One device's share of a :class:`ReplicaSet`: the device, its own
     copy of the params (flattened, pre-placed), and per-replica serving
     counters.  Counter writes happen under the owning cache's lock (the
-    same lock as the bucket counters); ``healthy`` flips one-way under
-    the replica set's lock."""
+    same lock as the bucket counters); ``healthy``, ``active`` and the
+    probe-backoff fields flip under the replica set's lock.
 
-    __slots__ = ("index", "device", "params_flat", "healthy",
+    ``healthy`` tracks fault state (a dispatch raised; restored by a
+    successful health re-probe).  ``active`` tracks the ELASTIC set: a
+    deactivated replica keeps its placed executables and params — warm,
+    idle, off the scheduler — so re-activation is a prime, never a
+    compile."""
+
+    __slots__ = ("index", "device", "params_flat", "healthy", "active",
+                 "probe_at", "probe_backoff",
                  "dispatches", "bucket_dispatches")
 
     def __init__(self, index: int, device, params_flat: List):
@@ -136,12 +146,15 @@ class Replica:
         self.device = device
         self.params_flat = params_flat
         self.healthy = True
+        self.active = True
+        self.probe_at = 0.0        # perf_counter time of the next probe
+        self.probe_backoff = 0.0   # current backoff step (seconds)
         self.dispatches = 0
         self.bucket_dispatches: Dict[int, int] = {}
 
     def __repr__(self):
         return (f"Replica({self.index}, {self.device}, "
-                f"healthy={self.healthy})")
+                f"healthy={self.healthy}, active={self.active})")
 
 
 class ReplicaSet:
@@ -169,13 +182,28 @@ class ReplicaSet:
     list.
 
     Fault handling: a replica whose dispatch raises is marked unhealthy
-    (one-way; a hot-swap deploys a fresh set) and the failed dispatch is
-    retried once on another healthy replica by the owning cache.  When
-    EVERY replica is unhealthy the set falls back to serving through
-    all of them — availability over purity, the gauge still shows red.
+    and the failed dispatch is retried once on another healthy replica
+    by the owning cache.  Recovery is structured, not luck: an
+    unhealthy replica is RE-PROBED with a cheap warmed no-op execute on
+    an exponential backoff (``maybe_reprobe``, driven from the
+    coalescer loop and the solo scheduler), and a probe that returns
+    flips it healthy again — so ``zoo_replica_unhealthy`` goes back to
+    0 without waiting for a hot-swap or a lucky retry.  When EVERY
+    replica is unhealthy the set still falls back to serving through
+    all of them — availability over purity, the gauge shows red until
+    a probe succeeds.
+
+    Elasticity: ``set_active(n)`` shrinks or grows the SCHEDULED set
+    (the autoscaler's lever).  Deactivated replicas keep executables
+    and params placed; re-activation primes every placed signature on
+    the joining replica BEFORE it takes traffic (the registry's
+    warm-before-activate discipline at runtime), so a scale-up never
+    serves a cold replica and never compiles.
     """
 
-    def __init__(self, fn: Callable, params, devices=None):
+    def __init__(self, fn: Callable, params, devices=None,
+                 probe_backoff_s: float = 0.5,
+                 probe_backoff_max_s: float = 30.0):
         self._fn = fn
         # one jit wrapper for the whole set: every bucket's lowering
         # comes from it (a per-compile jax.jit would re-trace per call)
@@ -203,10 +231,21 @@ class ReplicaSet:
         self._lock = threading.Lock()
         self._compile_locks: Dict[Tuple, threading.Lock] = {}
         self._rr = 0
+        self.probe_backoff_s = float(probe_backoff_s)
+        self.probe_backoff_max_s = float(probe_backoff_max_s)
+        # fast-path gate for maybe_reprobe: scanning the replica tuple
+        # per dispatch is cheap, but one int compare is cheaper
+        self._unhealthy_count = 0
+        # serializes probes (dispatcher + solo threads may both ask)
+        self._probe_guard = threading.Lock()
 
     @property
     def n(self) -> int:
         return len(self.replicas)
+
+    @property
+    def n_active(self) -> int:
+        return sum(1 for r in self.replicas if r.active)
 
     @staticmethod
     def _key(batched) -> Tuple:
@@ -315,24 +354,164 @@ class ReplicaSet:
         outs = exe.execute(args)
         return jax.tree_util.tree_unflatten(self._out_tree[key], outs)
 
+    # ---- elasticity ----
+    def _zeros_for(self, key: Tuple) -> List[np.ndarray]:
+        """A host batch matching a placed signature — the key IS the
+        full per-leaf (shape, dtype) list, so a warmed no-op input
+        needs no remembered sample."""
+        return [np.zeros(shape, dtype) for shape, dtype in key]
+
+    def _prime(self, replica: Replica) -> None:
+        """Execute every placed signature once on ``replica`` —
+        warm-before-activate (and the probe body).  Never compiles:
+        the executables were placed at ensure_compiled time (placement
+        covers INACTIVE replicas too, exactly so this stays a load).
+        Fetches via explicit device_get — priming must not leave work
+        in flight behind the activation flip."""
+        for key in list(self._exes):
+            jax.device_get(self.dispatch(replica, self._zeros_for(key),
+                                         key=key))
+
+    def set_active(self, n: int) -> int:
+        """Resize the scheduled replica set to ``n`` replicas (clamped
+        to [1, total]); returns the active count.  Selection is
+        HEALTH-AWARE, lowest index first: a dead replica must not hold
+        a seat — or fail the whole resize from inside its prime —
+        while healthy spares sit deactivated, so when healthy replicas
+        run short the remainder fills with unhealthy ones, unprimed
+        (the scheduler routes around them until their probe heals;
+        placement already covered them, so healing never compiles).
+        Healthy joiners are primed BEFORE the flag flips, so the
+        scheduler never routes to a replica whose first request would
+        pay lazy init; a joiner whose prime raises is marked unhealthy
+        and the resize carries on with the rest.  Deactivation only
+        unschedules: in-flight groups resolve normally and the replica
+        keeps its warm state."""
+        n = max(1, min(int(n), len(self.replicas)))
+        chosen = {r.index for r in
+                  sorted(self.replicas,
+                         key=lambda r: (not r.healthy, r.index))[:n]}
+        joining = [r for r in self.replicas
+                   if r.index in chosen and not r.active]
+        leaving = [r for r in self.replicas
+                   if r.active and r.index not in chosen]
+        for r in joining:
+            if not r.healthy:
+                continue  # never dispatch a prime to a red device
+            try:
+                self._prime(r)
+            except RuntimeError as e:
+                self.mark_unhealthy(r, e)
+        with self._lock:
+            for r in self.replicas:
+                r.active = r.index in chosen
+        if joining or leaving:
+            _slog.info("replica_set_active", active=n,
+                       total=len(self.replicas),
+                       joined=[r.index for r in joining],
+                       left=[r.index for r in leaving])
+        return n
+
     # ---- health / scheduling ----
     def healthy_indices(self) -> List[int]:
-        """Replica indices eligible for dispatch.  Falls back to ALL
-        replicas when every one is marked unhealthy — a fully-red set
-        keeps serving (and keeps showing red) rather than bricking."""
-        out = [r.index for r in self.replicas if r.healthy]
+        """Replica indices eligible for dispatch: active AND healthy.
+        Falls back to the active set when every active replica is
+        marked unhealthy (a fully-red set keeps serving — and keeps
+        showing red — rather than bricking), then to ALL replicas."""
+        out = [r.index for r in self.replicas if r.healthy and r.active]
+        if out:
+            return out
+        out = [r.index for r in self.replicas if r.active]
         return out if out else [r.index for r in self.replicas]
 
     def mark_unhealthy(self, replica: Replica, exc: BaseException):
+        now = time.perf_counter()
         with self._lock:
-            replica.healthy = False
+            if replica.healthy:
+                replica.healthy = False
+                self._unhealthy_count += 1
+            replica.probe_backoff = max(replica.probe_backoff,
+                                        self.probe_backoff_s)
+            replica.probe_at = now + replica.probe_backoff
         _slog.error("replica_unhealthy", replica=replica.index,
                     device=str(replica.device),
+                    probe_in_s=round(replica.probe_backoff, 3),
                     error=f"{type(exc).__name__}: {exc}")
+
+    def maybe_reprobe(self) -> None:
+        """Time-gated health re-probe of unhealthy replicas: a cheap
+        warmed no-op execute per due replica, on exponential backoff
+        (``probe_backoff_s`` doubling to ``probe_backoff_max_s``).  A
+        probe that returns flips the replica healthy — recovery no
+        longer depends on live-traffic retry luck.  Cost when all
+        replicas are healthy: one int compare.
+
+        The probe itself runs on a DETACHED daemon thread: this method
+        is driven from the coalescer dispatcher and solo request
+        threads, and a device that fails SLOWLY (wedged rather than
+        raising) must stall the probe thread, not live traffic on the
+        healthy replicas.  The non-blocking guard (held by the probe
+        thread until it finishes) keeps concurrent dispatch paths from
+        stacking probes."""
+        if not self._unhealthy_count:
+            return
+        now = time.perf_counter()
+        due = [r for r in self.replicas
+               if not r.healthy and r.probe_at <= now]
+        if not due:
+            return
+        if not self._probe_guard.acquire(blocking=False):
+            return
+        threading.Thread(target=self._probe_due, args=(due,),
+                         name="zoo-replica-probe", daemon=True).start()
+
+    def _probe_due(self, due: List[Replica]) -> None:
+        """Probe-thread body: probe each due replica, then release the
+        guard (the guard is acquired by maybe_reprobe and handed to
+        this thread)."""
+        try:
+            for r in due:
+                self._probe(r)
+        finally:
+            self._probe_guard.release()
+
+    def _probe(self, replica: Replica) -> bool:
+        """One health probe: execute the smallest placed signature on
+        ``replica`` and fetch the result.  Success restores health
+        (and resets the backoff); device-side failure doubles it."""
+        with self._lock:
+            keys = list(self._exes)
+        if not keys:
+            return False  # nothing placed yet — nothing warm to probe
+        key = min(keys, key=lambda k: k[0][0][0] if k and k[0][0] else 0)
+        try:
+            jax.device_get(self.dispatch(replica, self._zeros_for(key),
+                                         key=key))
+        except RuntimeError as e:
+            with self._lock:
+                replica.probe_backoff = min(replica.probe_backoff * 2.0
+                                            or self.probe_backoff_s,
+                                            self.probe_backoff_max_s)
+                replica.probe_at = (time.perf_counter()
+                                    + replica.probe_backoff)
+            _slog.info("replica_probe_failed", replica=replica.index,
+                       next_probe_in_s=round(replica.probe_backoff, 3),
+                       error=f"{type(e).__name__}: {e}")
+            return False
+        with self._lock:
+            if not replica.healthy:
+                replica.healthy = True
+                self._unhealthy_count -= 1
+            replica.probe_backoff = self.probe_backoff_s
+        _slog.info("replica_recovered", replica=replica.index,
+                   device=str(replica.device))
+        return True
 
     def retry_target(self, failed: Replica) -> Optional[Replica]:
         """A healthy replica other than ``failed`` (round-robin), or
-        None when there is nowhere left to retry."""
+        None when there is nowhere left to retry.  Inactive-but-healthy
+        replicas are eligible — they are warm and idle, the best
+        possible place for a one-off retry."""
         with self._lock:
             cands = [r for r in self.replicas
                      if r.healthy and r is not failed]
@@ -342,23 +521,31 @@ class ReplicaSet:
             return cands[self._rr % len(cands)]
 
     def pick(self) -> Replica:
-        """Round-robin over healthy replicas — the solo (non-coalesced)
-        path's scheduler.  The coalescer's dispatcher uses
-        least-outstanding-work instead (it owns the in-flight counts)."""
+        """Round-robin over active healthy replicas — the solo
+        (non-coalesced) path's scheduler.  The coalescer's dispatcher
+        uses least-outstanding-work instead (it owns the in-flight
+        counts).  Also the solo path's probe driver: each pick gives
+        due unhealthy replicas their time-gated recovery probe."""
+        self.maybe_reprobe()
         with self._lock:
-            idxs = [r.index for r in self.replicas if r.healthy]
+            idxs = [r.index for r in self.replicas
+                    if r.healthy and r.active]
             if not idxs:
-                idxs = [r.index for r in self.replicas]
+                idxs = [r.index for r in self.replicas if r.active] \
+                    or [r.index for r in self.replicas]
             self._rr += 1
             return self.replicas[idxs[self._rr % len(idxs)]]
 
     def stats(self) -> Dict[str, Any]:
         return {
             "replicas": len(self.replicas),
+            "replicas_active": self.n_active,
             "replica_dispatches": {r.index: r.dispatches
                                    for r in self.replicas},
             "replica_unhealthy": {r.index: (not r.healthy)
                                   for r in self.replicas},
+            "replica_active": {r.index: r.active
+                               for r in self.replicas},
             "replica_bucket_dispatches": {
                 r.index: dict(r.bucket_dispatches)
                 for r in self.replicas},
@@ -791,12 +978,25 @@ class RequestCoalescer:
     same device-concurrency budget as solo calls.
     """
 
+    # forced loser-drain budget: a pending hedge loser still in flight
+    # past this is treated as WEDGED (its replica marked unhealthy)
+    # instead of blocking the dispatcher indefinitely.  Class-level so
+    # tests can shrink it per instance.
+    _WEDGE_TIMEOUT_S = 30.0
+    # the hedge threshold quantile is recomputed every N group
+    # resolves, not per group (see _hedge_threshold_s)
+    _HEDGE_THR_REFRESH = 32
+
     def __init__(self, cache: BucketedExecutableCache,
                  max_batch: Optional[int] = None,
                  max_wait_ms: float = 2.0,
                  semaphore: Optional[threading.Semaphore] = None,
                  pipeline_depth: int = 2,
-                 queue_size: int = 1024):
+                 queue_size: int = 1024,
+                 hedging: bool = False,
+                 hedge_quantile: float = 0.99,
+                 hedge_min_ms: float = 0.5,
+                 hedge_min_samples: int = 20):
         self._cache = cache
         self.max_batch = int(max_batch or cache.max_batch)
         self.max_wait_ms = float(max_wait_ms)
@@ -811,6 +1011,34 @@ class RequestCoalescer:
         self._slot_inflight = [0] * self._n_slots
         self._slot_rr = 0
         self._arena = _StagingArena(self._slot_cap)
+        # ---- p99 hedging (device-parallel only: a hedge needs a
+        # second replica to win on).  The threshold derives from the
+        # observed group resolve-latency quantile, so "straggler"
+        # means straggler RELATIVE to this model's own distribution.
+        self.hedging = bool(hedging) and self._rs is not None
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_min_ms = float(hedge_min_ms)
+        self.hedge_min_samples = int(hedge_min_samples)
+        self._group_lat = _LatencyWindow(maxlen=512)
+        # dispatcher-thread-owned threshold cache (see
+        # _hedge_threshold_s): (value, window count at compute)
+        self._hedge_thr: Optional[float] = None
+        self._hedge_thr_at = -1
+        # dispatcher-thread-owned counters (read via dict copy)
+        self._hedges = {"fired": 0, "primary_won": 0, "hedge_won": 0,
+                        "skipped_no_replica": 0}
+        self._hedge_pool: Optional[ThreadPoolExecutor] = None
+        # loser futures already reported as wedged (bounded: entries
+        # leave when their loser retires)
+        self._wedged_reported: set = set()
+        # hedge losers still aliasing a staging buffer: (primary_slot,
+        # future, hedge_replica_index|None).  The primary slot's
+        # in-flight count is held until the losing fetch returns (the
+        # PR 5 retry-window ownership rule — see _drain_losers); the
+        # third element releases the hedge replica's own in-flight
+        # count when the pending loser IS the hedge
+        self._pending_losers: List[Tuple[int, Future,
+                                         Optional[int]]] = []
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._carry: Optional[_Request] = None
         self.dispatches = 0
@@ -847,6 +1075,11 @@ class RequestCoalescer:
         """Submitted-but-unresolved request count (queued + in flight)."""
         with self._out_lock:
             return self._outstanding
+
+    def hedge_stats(self) -> Dict[str, int]:
+        """Copy of the hedge outcome counters (dispatcher-owned ints;
+        the copy is GIL-atomic enough for a metrics scrape)."""
+        return dict(self._hedges)
 
     def submit(self, batched, span=None) -> Future:
         n = _rows(batched)
@@ -989,7 +1222,7 @@ class RequestCoalescer:
             return
         while not self._sem.acquire(blocking=False):
             if inflight:
-                self._resolve(*inflight.popleft())
+                self._resolve(inflight.popleft())
             else:
                 self._sem.acquire()  # held by solo callers — just wait
                 return
@@ -1042,10 +1275,13 @@ class RequestCoalescer:
         return len(self._rs.healthy_indices()) * self._slot_cap
 
     def _dispatch_group(self, group: List[_Request], inflight):
-        """Stage into the arena + async dispatch; returns
-        (group, rows, device_out, slot) or None when the dispatch
-        itself failed.  The caller guarantees a free slot (arena-reuse
-        safety — see :class:`_StagingArena`)."""
+        """Stage into the arena + async dispatch; returns an in-flight
+        entry (group, rows, device_out, slot, t_dispatch, padded_batch,
+        placement_key) or None when the dispatch itself failed.  The
+        caller guarantees a free slot (arena-reuse safety — see
+        :class:`_StagingArena`).  The padded batch and placement key
+        ride along so a later hedge can re-dispatch the SAME staged
+        buffer to another replica without re-packing."""
         try:
             spans = tuple(r.span for r in group if r.span is not None)
             for s in spans:
@@ -1056,6 +1292,8 @@ class RequestCoalescer:
             batched = self._arena.pack(group, bucket, slot)
             replica = (self._rs.replicas[slot]
                        if self._rs is not None else None)
+            key = (ReplicaSet.key_from(bucket, group[0].sig)
+                   if self._rs is not None else None)
             self._acquire_slot(inflight)
             try:
                 dev = self._cache.dispatch_padded(batched, spans,
@@ -1077,7 +1315,7 @@ class RequestCoalescer:
             # groups) is bounded to the rare fault window and
             # self-corrects at resolve.
             self._slot_inflight[slot] += 1
-            return group, n, dev, slot
+            return group, n, dev, slot, time.perf_counter(), batched, key
         except BaseException as e:
             self._done(len(group))
             for r in group:
@@ -1085,20 +1323,300 @@ class RequestCoalescer:
                     r.future.set_exception(e)
             return None
 
-    def _resolve(self, group: List[_Request], n: int, dev, slot: int = 0):
-        """Fetch a dispatched group's device result and fan rows out."""
+    # ---- resolve (plain + hedged) ----
+    def _fetch_slot(self, dev, n: int, slot: int):
+        """Blocking host fetch of a dispatched group.  A method (not a
+        bare ``fetch_rows`` call) so tests and the bench can patch a
+        per-slot straggler delay in — the injection point for the
+        hedging gates."""
+        return fetch_rows(dev, n)
+
+    def _fetch_hedge(self, dev, n: int, replica_index: int):
+        """Blocking host fetch of a hedge re-dispatch (separate patch
+        point: a test can delay the hedge to pin primary-wins)."""
+        return fetch_rows(dev, n)
+
+    def _hedge_threshold_s(self) -> Optional[float]:
+        """The in-flight age past which a group is hedged: the
+        ``hedge_quantile`` of observed group resolve latencies, floored
+        by ``hedge_min_ms``.  None until ``hedge_min_samples`` groups
+        have resolved — hedging from an unseeded distribution would
+        fire on noise.  The quantile is recomputed only every
+        ``_HEDGE_THR_REFRESH`` resolves: ``percentile`` sorts the whole
+        window under its lock, and a quantile over a 512-sample window
+        barely moves across 32 adds — per-group sorting on the
+        dispatcher's hot path bought nothing."""
+        c = self._group_lat.count
+        if c < self.hedge_min_samples:
+            return None
+        if (self._hedge_thr is None
+                or c - self._hedge_thr_at >= self._HEDGE_THR_REFRESH):
+            q = self._group_lat.percentile(self.hedge_quantile * 100.0)
+            if q is None:
+                return None
+            self._hedge_thr = max(q, self.hedge_min_ms / 1e3)
+            self._hedge_thr_at = c
+        return self._hedge_thr
+
+    def _hedge_target(self, slot: int) -> Optional[Replica]:
+        """A healthy, ACTIVE replica other than the primary's — the
+        least-loaded one.  None when fewer than 2 replicas are
+        eligible: hedging no-ops rather than re-dispatching onto the
+        same straggler (or a red/retired replica)."""
+        rs = self._rs
+        cands = [r for r in rs.replicas
+                 if r.healthy and r.active and r.index != slot]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: self._slot_inflight[r.index])
+
+    def _hedge_executor(self) -> ThreadPoolExecutor:
+        if self._hedge_pool is None:
+            # sized so pending loser fetches can never starve the next
+            # group's primary+hedge pair of workers: every in-flight
+            # slot (n_slots * slot_cap) could be holding a straggling
+            # loser, plus the pair itself
+            self._hedge_pool = ThreadPoolExecutor(
+                max_workers=self._n_slots * self._slot_cap + 2,
+                thread_name_prefix="zoo-serving-hedge")
+        return self._hedge_pool
+
+    def _swallow_loser(self, fut: Future):
+        """Consume a losing fetch's outcome.  Its result is moot (the
+        winner already served the group) and its error must not
+        propagate — the hedge existed precisely because that replica
+        was misbehaving."""
         try:
-            out = fetch_rows(dev, n)
+            fut.result()
+        except BaseException as e:  # noqa: BLE001 — deliberate sink
+            _slog.info("hedge_loser_error",
+                       error=f"{type(e).__name__}: {e}")
+
+    def _drain_losers(self, block: bool = False) -> bool:
+        """Retire finished hedge losers and release their slot
+        ownership.  ARENA-OWNERSHIP RULE: a losing dispatch's zero-copy
+        ``device_put`` aliases the SAME staging buffer as the primary
+        (the hedge re-dispatched the staged batch), so the primary
+        slot's in-flight count — which is what guards that buffer
+        against rewrite — stays held until the losing execute+fetch
+        returns, exactly like the PR 5 retry-window rule.  ``block``
+        (used when every slot is pinned and nothing else can free one)
+        waits for whichever pending loser finishes FIRST — never the
+        oldest specifically, which could wedge behind a dead fetch
+        while a newer done loser sat ready to free a slot — bounded by
+        ``_WEDGE_TIMEOUT_S``: past it the still-pending losers'
+        replicas are marked unhealthy instead of stalling the
+        dispatcher forever.  Returns whether any loser was retired."""
+        retired = False
+        remaining: List[Tuple[int, Future, Optional[int]]] = []
+        for slot, fut, alt_idx in self._pending_losers:
+            if fut.done():
+                self._swallow_loser(fut)
+                self._wedged_reported.discard(id(fut))
+                if 0 <= slot < len(self._slot_inflight):
+                    self._slot_inflight[slot] -= 1
+                if alt_idx is not None:
+                    # the pending loser was the hedge: its replica's
+                    # own in-flight count releases with it
+                    self._slot_inflight[alt_idx] -= 1
+                retired = True
+            else:
+                remaining.append((slot, fut, alt_idx))
+        self._pending_losers = remaining
+        if block and not retired and remaining:
+            done, _ = _futures_wait([f for _, f, _ in remaining],
+                                    timeout=self._WEDGE_TIMEOUT_S,
+                                    return_when=FIRST_COMPLETED)
+            if done:
+                return self._drain_losers()
+            self._mark_wedged_losers()
+        return retired
+
+    def _mark_wedged_losers(self):
+        """Every pending loser outlived the wedge budget: mark each
+        one's replica unhealthy (one-way, once per loser) so
+        scheduling, hedging, and the recovery probe treat the device
+        as red.  The slot counts stay held — the wedged dispatch still
+        aliases its staging buffer (arena-ownership rule), so only its
+        fetch returning can release the buffer for rewrite."""
+        if self._rs is None:
+            return
+        for slot, fut, alt_idx in self._pending_losers:
+            if id(fut) in self._wedged_reported:
+                continue
+            self._wedged_reported.add(id(fut))
+            idx = alt_idx if alt_idx is not None else slot
+            if 0 <= idx < len(self._rs.replicas):
+                self._rs.mark_unhealthy(
+                    self._rs.replicas[idx],
+                    RuntimeError(
+                        f"hedge loser fetch wedged for more than "
+                        f"{self._WEDGE_TIMEOUT_S:g}s"))
+
+    def _resolve(self, item):
+        """Fetch a dispatched group's device result and fan rows out.
+        ``item`` is a ``_dispatch_group`` in-flight entry."""
+        group, n, dev, slot, t0, batched, key = item
+        if self.hedging:
+            thr = self._hedge_threshold_s()
+            if thr is not None:
+                self._resolve_hedged(group, n, dev, slot, t0, batched,
+                                     key, thr)
+                return
+            # unseeded window: a hedge cannot fire, so don't pay the
+            # pool submit + cross-thread wakeup — fetch inline below
+        try:
+            out = self._fetch_slot(dev, n, slot)
             err = None
         except BaseException as e:
             out, err = None, e
-        # retire the group from the live count BEFORE waking callers, so
-        # their resubmissions aren't double-counted against the next
-        # gather's early-dispatch check
+        self._group_lat.add(time.perf_counter() - t0)
+        self._retire(group, slot)
+        self._fan_out(group, out, err)
+
+    def _resolve_hedged(self, group: List[_Request], n: int, dev,
+                        slot: int, t0: float, batched, key,
+                        thr: float):
+        """First-wins resolve: wait for the primary fetch until the
+        group's in-flight age crosses ``thr`` (the quantile-derived
+        hedge threshold); past it, re-dispatch the SAME staged batch to
+        a second healthy replica and take whichever result lands first.
+        Results are bit-exact either way (same serialized executable on
+        every replica — the PR 5 pin), so the race is free of output
+        tearing by construction.  The loser's slot accounting is
+        deferred to :meth:`_drain_losers` (arena-ownership rule)."""
+        pool = self._hedge_executor()
+        fut_p = pool.submit(self._fetch_slot, dev, n, slot)
+        # the latency window learns the PRIMARY's true latency, win or
+        # lose — recording the group's first-wins latency would feed
+        # the threshold its own output (hedged groups resolve at the
+        # fast replica's speed, the quantile sinks toward it, and a
+        # persistent straggler ends up hedged on nearly every dispatch
+        # instead of only at the tail)
+        fut_p.add_done_callback(
+            lambda _f, _t0=t0: self._group_lat.add(
+                time.perf_counter() - _t0))
+        fut_h = None
+        alt = None
+        remaining = (t0 + thr) - time.perf_counter()
+        done, _ = _futures_wait([fut_p], timeout=max(remaining, 0.0))
+        if not done:
+            alt = self._hedge_target(slot)
+            if alt is None:
+                # <2 eligible replicas: hedging must no-op (there
+                # is nowhere independent to win on)
+                self._hedges["skipped_no_replica"] += 1
+            else:
+                try:
+                    dev2 = self._rs.dispatch(alt, batched, key=key)
+                except RuntimeError as e:
+                    # a failed hedge never fails the group — the
+                    # primary is still in flight and authoritative
+                    self._rs.mark_unhealthy(alt, e)
+                    alt = None
+                else:
+                    self._hedges["fired"] += 1
+                    # hedge work is real load: the schedulers
+                    # (least-outstanding-work + _hedge_target) must
+                    # see it in flight, and operators must see it in
+                    # the per-replica dispatch counters
+                    self._slot_inflight[alt.index] += 1
+                    bucket = _rows(batched)
+                    with self._cache._lock:
+                        alt.dispatches += 1
+                        alt.bucket_dispatches[bucket] = \
+                            alt.bucket_dispatches.get(bucket, 0) + 1
+                    fut_h = pool.submit(self._fetch_hedge, dev2, n,
+                                        alt.index)
+        winner, loser = fut_p, None
+        if fut_h is not None:
+            done, _ = _futures_wait([fut_p, fut_h],
+                                    return_when=FIRST_COMPLETED)
+            winner = fut_p if fut_p in done else fut_h
+            loser = fut_h if winner is fut_p else fut_p
+        try:
+            out = winner.result()
+            err = None
+        except BaseException as e:
+            if loser is not None:
+                # the winner crashed first — the other dispatch may
+                # still deliver the group.  Bounded wait: a WEDGED
+                # loser (the very failure hedging routes around) must
+                # not stall the dispatcher forever on .result()
+                _futures_wait([loser], timeout=self._WEDGE_TIMEOUT_S)
+                if loser.done():
+                    try:
+                        out, err = loser.result(), None
+                    except BaseException as e2:
+                        out, err = None, e2
+                    # the other future actually delivered (or crashed
+                    # last): IT is the winner for outcome attribution,
+                    # and nothing is left in flight to track
+                    winner, loser = loser, None
+                else:
+                    # both dispatches failed the group: the crash is
+                    # the answer.  The wedged fetch stays the tracked
+                    # loser (pending-loser path below), holding its
+                    # slot so the aliased buffer is never rewritten —
+                    # and its replica goes red NOW (the budget already
+                    # elapsed; don't wait for a forced drain to notice)
+                    out, err = None, e
+                    idx = alt.index if loser is fut_h else slot
+                    self._wedged_reported.add(id(loser))
+                    self._rs.mark_unhealthy(
+                        self._rs.replicas[idx],
+                        RuntimeError(
+                            f"hedge fetch wedged for more than "
+                            f"{self._WEDGE_TIMEOUT_S:g}s"))
+            else:
+                out, err = None, e
+        if fut_h is not None and err is None:
+            # outcome recorded AFTER the result was actually delivered
+            # — a hedge that completed first by CRASHING must not count
+            # as (or trace as) a win the primary then served
+            outcome = ("primary_won" if winner is fut_p
+                       else "hedge_won")
+            self._hedges[outcome] += 1
+            for r in group:
+                if r.span is not None:
+                    r.span.event("hedge", outcome=outcome,
+                                 primary_slot=slot,
+                                 hedge_replica=alt.index)
+        self._inflight_n -= len(group)
+        alt_released = fut_h is None  # no hedge → nothing to release
+        if loser is not None and not loser.done():
+            # slot stays owned until the losing execute returns — its
+            # zero-copy upload still aliases this slot's buffer
+            pend_alt = None
+            if loser is fut_h:
+                pend_alt = alt.index  # _drain_losers releases it
+                alt_released = True
+            self._pending_losers.append((slot, loser, pend_alt))
+        else:
+            if loser is not None:
+                self._swallow_loser(loser)
+            if 0 <= slot < len(self._slot_inflight):
+                self._slot_inflight[slot] -= 1
+        if not alt_released:
+            # the hedge future has fully resolved (it won, or was
+            # consumed): its replica's in-flight count releases now
+            self._slot_inflight[alt.index] -= 1
+        self._done(len(group))
+        self._fan_out(group, out, err)
+
+    def _retire(self, group: List[_Request], slot: int):
+        """Un-count a resolved group (live count, slot, outstanding).
+        Runs BEFORE waking callers, so their resubmissions aren't
+        double-counted against the next gather's early-dispatch
+        check."""
         self._inflight_n -= len(group)
         if 0 <= slot < len(self._slot_inflight):
             self._slot_inflight[slot] -= 1
         self._done(len(group))
+
+    def _fan_out(self, group: List[_Request], out, err):
+        """Fan a fetched group's rows (or its error) onto each caller's
+        future and release the device-concurrency slot."""
         try:
             if err is None:
                 off = 0
@@ -1144,7 +1662,7 @@ class RequestCoalescer:
             # their callers and return their device-concurrency slots
             # (a leaked slot would wedge the solo fallback path)
             while self._inflight:
-                group, _, _, _ = self._inflight.popleft()
+                group = self._inflight.popleft()[0]
                 self._done(len(group))
                 for r in group:
                     if not r.future.done():
@@ -1152,12 +1670,27 @@ class RequestCoalescer:
                 if self._sem is not None:
                     self._sem.release()
             raise
+        finally:
+            # the dispatcher owns the hedge pool; once it exits no
+            # buffer is ever staged again, so in-flight loser fetches
+            # may finish unobserved (wait=False keeps a wedged fetch
+            # from hanging shutdown)
+            if self._hedge_pool is not None:
+                self._hedge_pool.shutdown(wait=False)
 
     def _loop_inner(self):
         # instance-held so the crash net can fail dispatched groups
         inflight = self._inflight
         shutdown = False
         while True:
+            if self._pending_losers:
+                # retire finished hedge losers first: each one done
+                # releases a slot (arena-ownership rule)
+                self._drain_losers()
+            if self._rs is not None:
+                # due unhealthy replicas get their recovery probe (one
+                # int compare when everything is green)
+                self._rs.maybe_reprobe()
             group: List[_Request] = []
             if not shutdown:
                 if inflight and self._carry is None and self._q.empty():
@@ -1165,7 +1698,7 @@ class RequestCoalescer:
                     # closed-loop caller is blocked on a future — fetch
                     # and fan the oldest out NOW so they can resubmit,
                     # instead of grace-waiting on a queue that cannot fill
-                    self._resolve(*inflight.popleft())
+                    self._resolve(inflight.popleft())
                 # gathering overlaps the in-flight groups' device
                 # compute.  Single-device: any in-flight group means no
                 # urgency; device-parallel: urgency ends only once every
@@ -1180,11 +1713,17 @@ class RequestCoalescer:
                 group, _ = self._gather(block=False)
             if group:
                 # arena-reuse safety: never stage while every eligible
-                # slot is at its in-flight cap — resolve FIFO until one
-                # frees (also how an unhealthy replica's stragglers get
-                # delivered before traffic re-routes around it)
-                while inflight and not self._has_free_capacity():
-                    self._resolve(*inflight.popleft())
+                # slot is at its in-flight cap — resolve FIFO (or wait
+                # out a hedge loser) until one frees (also how an
+                # unhealthy replica's stragglers get delivered before
+                # traffic re-routes around it)
+                while not self._has_free_capacity():
+                    if inflight:
+                        self._resolve(inflight.popleft())
+                    elif self._pending_losers:
+                        self._drain_losers(block=True)
+                    else:
+                        break  # counts only come from the two above
                 disp = self._dispatch_group(group, inflight)
                 if disp is not None:
                     inflight.append(disp)
@@ -1193,6 +1732,16 @@ class RequestCoalescer:
             # no new work arrived to overlap with)
             if inflight and (not group
                              or len(inflight) >= self._capacity()):
-                self._resolve(*inflight.popleft())
+                self._resolve(inflight.popleft())
             if shutdown and not inflight and self._carry is None:
+                while self._pending_losers:
+                    if not self._drain_losers(block=True):
+                        # the wedge budget elapsed with zero progress:
+                        # abandoning the wedged fetches beats hanging
+                        # shutdown forever — no buffer is ever staged
+                        # again after return, and the hedge pool shuts
+                        # down wait=False
+                        _slog.info("shutdown_abandons_wedged_losers",
+                                   n=len(self._pending_losers))
+                        break
                 return

@@ -1,10 +1,12 @@
 """Serving control plane: multi-model registry, zero-downtime hot-swap,
-admission control & load shedding, canary traffic splitting, and a
-metrics snapshot API — the lifecycle layer over the
-``pipeline.inference`` data plane (bucketed executables + request
-coalescing).  See docs/serving.md §"Control plane"."""
+admission control & priority-aware load shedding, canary traffic
+splitting, replica autoscaling, and a metrics snapshot API — the
+lifecycle layer over the ``pipeline.inference`` data plane (bucketed
+executables + request coalescing + replica sets).  See docs/serving.md
+§"Control plane" and §"Elasticity"."""
 
 from .admission import AdmissionController
+from .autoscale import Autoscaler, autoscaler_for
 from .errors import (DeadlineExceeded, DeployError, ModelNotFound,
                      Overloaded, ServingError, error_response)
 from .metrics import (Counters, LatencyWindow, registry_collector,
@@ -12,8 +14,8 @@ from .metrics import (Counters, LatencyWindow, registry_collector,
 from .registry import ModelRegistry
 
 __all__ = [
-    "AdmissionController", "Counters", "DeadlineExceeded", "DeployError",
-    "LatencyWindow", "ModelNotFound", "ModelRegistry", "Overloaded",
-    "ServingError", "error_response", "registry_collector",
-    "registry_families",
+    "AdmissionController", "Autoscaler", "Counters", "DeadlineExceeded",
+    "DeployError", "LatencyWindow", "ModelNotFound", "ModelRegistry",
+    "Overloaded", "ServingError", "autoscaler_for", "error_response",
+    "registry_collector", "registry_families",
 ]

@@ -26,7 +26,10 @@ _ADMISSION_GAUGES = ("queue_depth", "running", "queue_high_water",
                      "max_queue", "max_concurrency")
 _ADMISSION_COUNTERS = ("admitted", "completed", "errors",
                        "shed_overload", "shed_deadline",
-                       "shed_draining", "deadline_lapsed")
+                       "shed_draining", "shed_evicted",
+                       "deadline_lapsed")
+_HEDGE_OUTCOMES = ("fired", "primary_won", "hedge_won",
+                   "skipped_no_replica")
 
 
 def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
@@ -60,7 +63,15 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
     replica_gauges: Dict[str, List] = {
         "zoo_replica_unhealthy": [],
         "zoo_model_replicas": [],
+        "zoo_model_replicas_active": [],
     }
+    # elastic serving: per-class admission + hedge outcomes
+    class_counters: Dict[str, List] = {
+        "zoo_shed_total": [],
+        "zoo_class_admitted_total": [],
+        "zoo_hedge_total": [],
+    }
+    class_gauges: Dict[str, List] = {"zoo_class_weight": []}
     coalescer_counters: Dict[str, List] = {
         "zoo_coalescer_dispatches_total": [],
         "zoo_coalesced_requests_total": [],
@@ -87,6 +98,18 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
             if c in adm:
                 admission[f"zoo_admission_{c}_total"].append(
                     (ml, adm[c]))
+        # per-priority-class admission: the shed counter is the
+        # overload-ordering contract ("lowest class sheds first") made
+        # observable, labeled by class; classes export even at zero so
+        # dashboards/alerts can pre-wire on deploy
+        for cname, cstats in sorted(adm.get("classes", {}).items()):
+            cl = {"model": model, "class": cname}
+            class_counters["zoo_shed_total"].append(
+                (cl, cstats.get("shed", 0)))
+            class_counters["zoo_class_admitted_total"].append(
+                (cl, cstats.get("admitted", 0)))
+            class_gauges["zoo_class_weight"].append(
+                (cl, cstats.get("weight", 0.0)))
         for version, stats in sorted(m.get("versions", {}).items()):
             # counters/summaries carry ONLY immutable labels: adding
             # the mutable state would fork the series on every
@@ -129,9 +152,19 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
         # device-parallel serving: per-replica dispatch counters (and
         # their per-bucket breakdown — the bucket metrics' replica
         # label) plus the health gauge
+        # request hedging: outcome-labeled counters (fired /
+        # primary_won / hedge_won / skipped_no_replica)
+        for outcome in _HEDGE_OUTCOMES:
+            v = serving.get("hedges", {}).get(outcome)
+            if v is not None:
+                class_counters["zoo_hedge_total"].append(
+                    ({"model": model, "outcome": outcome}, v))
         if serving.get("replica_dispatches"):
             replica_gauges["zoo_model_replicas"].append(
                 (ml, serving.get("replicas", 1)))
+            if "replicas_active" in serving:
+                replica_gauges["zoo_model_replicas_active"].append(
+                    (ml, serving["replicas_active"]))
             for rep, v in sorted(serving["replica_dispatches"].items()):
                 replica_counters["zoo_replica_dispatches_total"].append(
                     ({"model": model, "replica": str(rep)}, v))
@@ -176,15 +209,26 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
             "device dispatches per (replica, bucket)",
         "zoo_replica_unhealthy":
             "1 when the replica was marked unhealthy by a failed "
-            "dispatch",
+            "dispatch (restored to 0 by a successful health re-probe)",
+        "zoo_model_replicas_active":
+            "replicas in the scheduled (elastic) set",
+        "zoo_shed_total":
+            "requests shed per priority class (all shed causes)",
+        "zoo_class_admitted_total":
+            "requests granted a slot per priority class",
+        "zoo_class_weight": "configured fair-share weight per class",
+        "zoo_hedge_total":
+            "hedged dispatch outcomes (fired/primary_won/hedge_won/"
+            "skipped_no_replica)",
     }
     out: List[Family] = []
     gauge_groups = (model_gauges, version_gauges, replica_gauges,
+                    class_gauges,
                     {k: v for k, v in admission.items()
                      if not k.endswith("_total")})
     counter_groups = (model_counters, version_counters,
                       bucket_counters, coalescer_counters,
-                      replica_counters,
+                      replica_counters, class_counters,
                       {k: v for k, v in admission.items()
                        if k.endswith("_total")})
     for groups, mtype in ((gauge_groups, "gauge"),

@@ -110,10 +110,15 @@ class ModelRegistry:
 
     def __init__(self, max_queue: int = 64, max_concurrency: int = 4,
                  default_deadline_ms: Optional[float] = None,
+                 priority_classes: Optional[Dict[str, Any]] = None,
                  tracer=None, **model_defaults: Any):
         self._max_queue = max_queue
         self._max_concurrency = max_concurrency
         self._default_deadline_ms = default_deadline_ms
+        # per-tenant admission classes, applied to every model's
+        # controller: {"name": (priority, weight)} or {"name":
+        # {"priority": ..., "weight": ...}} — see AdmissionController
+        self._priority_classes = priority_classes
         # optional observability.Tracer: when set, every predict_ex
         # carries a request span through admission and the data plane
         self.tracer = tracer
@@ -142,7 +147,8 @@ class ModelRegistry:
                 e = _Entry(name, AdmissionController(
                     max_queue=self._max_queue,
                     max_concurrency=self._max_concurrency,
-                    default_deadline_ms=self._default_deadline_ms))
+                    default_deadline_ms=self._default_deadline_ms,
+                    classes=self._priority_classes))
                 self._entries[name] = e
             return e
 
@@ -285,6 +291,12 @@ class ModelRegistry:
         version is still serving."""
         reps = getattr(dep.model, "n_replicas", 1) or 1
         entry.admission.set_max_concurrency(self._max_concurrency * reps)
+        # the service-time EWMA describes the version that just
+        # RETIRED: carrying a slow old model's estimate forward would
+        # predictively shed deadline requests the fast new version
+        # could meet (and vice versa hides real slowness behind a
+        # stale fast estimate) — every activation starts clean
+        entry.admission.reset_service_ewma()
 
     def promote(self, name: str) -> int:
         """Make the staged canary the active version (atomic swap,
@@ -331,13 +343,16 @@ class ModelRegistry:
             del entry.retired[:-_RETIRED_KEPT]
 
     # ---- serving ----
-    def predict(self, name: str, inputs, deadline_ms: Optional[float] = None):
-        out, _ = self.predict_ex(name, inputs, deadline_ms=deadline_ms)
+    def predict(self, name: str, inputs, deadline_ms: Optional[float] = None,
+                priority_class: Optional[str] = None):
+        out, _ = self.predict_ex(name, inputs, deadline_ms=deadline_ms,
+                                 priority_class=priority_class)
         return out
 
     def predict_ex(self, name: str, inputs,
                    deadline_ms: Optional[float] = None,
-                   trace_id: Optional[str] = None
+                   trace_id: Optional[str] = None,
+                   priority_class: Optional[str] = None
                    ) -> Tuple[Any, Dict[str, Any]]:
         """predict + routing info ``{"model", "version", "canary"}`` —
         the web frontend tags responses with the serving version so
@@ -350,7 +365,11 @@ class ModelRegistry:
         through admission and the data plane; the span is activated for
         this thread and handed across the coalescer explicitly, and
         ``info`` gains ``request_id``.  Shed/failed requests finish
-        their span too, labeled with the error type."""
+        their span too, labeled with the error type.
+
+        ``priority_class`` tags the request for the admission
+        controller's shedding order and weighted fair share (the
+        registry's ``priority_classes`` config names the classes)."""
         entry = self._entry(name)
         tracer = self.tracer
         span = (tracer.start_span("predict", trace_id=trace_id,
@@ -359,7 +378,8 @@ class ModelRegistry:
         try:
             with _trace.activate(span), \
                     entry.admission.admit(deadline_ms=deadline_ms,
-                                          span=span):
+                                          span=span,
+                                          priority_class=priority_class):
                 dep, is_canary = self._route(entry)
                 if span is not None:
                     span.set_label("version", dep.version)

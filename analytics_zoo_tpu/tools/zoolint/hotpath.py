@@ -27,7 +27,13 @@ coalescer dispatcher), ``submit``, ``dispatch_padded``, plus the
 multi-replica scheduler loop's own pieces — ``dispatch`` (the
 ReplicaSet per-replica dispatch) and ``pack`` (the staging arena fill,
 dispatcher-thread hot) — so ZL301/302/601 cover the device-parallel
-path even if the coalescer loop is later refactored around it.
+path even if the coalescer loop is later refactored around it.  The
+elastic layer adds its own entries: ``tick`` (the autoscaler control
+step — it primes replicas inline, so a stray sync or print there
+stalls scale-ups), ``_resolve_hedged`` (the hedge dispatch/first-wins
+resolve), and ``maybe_reprobe`` (the health-probe driver) — all three
+run on or block serving threads even though none is reachable from
+``predict`` by name alone.
 """
 
 from __future__ import annotations
@@ -39,7 +45,8 @@ from .context import ModuleContext, QualnameVisitor, last_name
 from .findings import Finding
 
 DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
-                       "dispatch_padded", "dispatch", "pack")
+                       "dispatch_padded", "dispatch", "pack",
+                       "tick", "_resolve_hedged", "maybe_reprobe")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

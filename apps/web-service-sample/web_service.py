@@ -10,7 +10,8 @@ tracing, Prometheus metrics, XLA profiling hooks).
 
 POST /predict {"instances": [[...], ...],              -> {"predictions": [...],
                "model": "default",       # optional        "model": ..., "version": ...,
-               "deadline_ms": 250}       # optional        "request_id": ...}
+               "deadline_ms": 250,       # optional        "request_id": ...}
+               "class": "interactive"}   # optional priority class
 POST /deploy  {"model": "default", "seed": 1,          -> {"model": ..., "version": v}
                "hidden": 16, "canary_fraction": 0.2}   # canary optional
 POST /promote {"model": "default"}                     -> {"version": v}
@@ -87,10 +88,16 @@ def build_registry():
     # schedules groups across them (run the self-test under
     # XLA_FLAGS=--xla_force_host_platform_device_count=N to see it on
     # CPU; scripts/smoke_serving.sh forces 2)
+    # two admission tenants: interactive traffic outlives batch under
+    # overload (higher priority -> shed last) and owns 90% of freed
+    # slots (fair-share weight); requests opt in via {"class": ...}
     registry = ModelRegistry(max_queue=64, max_concurrency=4,
                              supported_concurrent_num=4,
                              max_batch_size=32, coalescing=True,
                              replicas="all",
+                             priority_classes={
+                                 "interactive": (10, 0.9),
+                                 "batch": (0, 0.1)},
                              tracer=tracer)
     metrics = MetricsRegistry()
     metrics.register_collector(registry_collector(registry))
@@ -189,7 +196,8 @@ def make_handler(registry, obs=None):
                     preds, info = registry.predict_ex(
                         payload.get("model", DEFAULT_MODEL), x,
                         deadline_ms=payload.get("deadline_ms"),
-                        trace_id=rid)
+                        trace_id=rid,
+                        priority_class=payload.get("class"))
                     self._reply(200, {
                         "predictions": np.asarray(preds).tolist(), **info},
                         headers={"X-Request-Id": rid})
@@ -354,7 +362,10 @@ def self_test(port: int):
           f"(coverage {best['coverage']:.1%}) OK")
 
     # ---- Prometheus exposition: scrape + round-trip the parser; the
-    # per-model/version/bucket labels must survive.
+    # per-model/version/bucket labels must survive.  A class-tagged
+    # request FIRST, so the per-class families carry a non-default
+    # series in the scrape.
+    call("/predict", {"instances": payloads[0], "class": "batch"})
     with urlopen(f"http://127.0.0.1:{port}/metrics?format=prometheus",
                  timeout=30) as resp:
         assert resp.headers["Content-Type"].startswith("text/plain")
@@ -363,10 +374,14 @@ def self_test(port: int):
     names = {k[0] for k in parsed["samples"]}
     required = ["zoo_model_requests_total", "zoo_bucket_hits_total",
                 "zoo_trace_spans_total", "zoo_xla_compiles_total",
-                "zoo_admission_completed_total"]
+                "zoo_admission_completed_total",
+                "zoo_shed_total", "zoo_class_admitted_total"]
     if n_dev > 1:
+        # the replica families (active gauge included) only exist on
+        # the multi-replica serving path
         required += ["zoo_replica_dispatches_total",
-                     "zoo_replica_unhealthy", "zoo_model_replicas"]
+                     "zoo_replica_unhealthy", "zoo_model_replicas",
+                     "zoo_model_replicas_active"]
     for name in required:
         assert name in names, f"{name} missing from exposition"
     labeled = [k for k in parsed["samples"]
@@ -374,6 +389,10 @@ def self_test(port: int):
     assert any(dict(k[1]).get("model") == DEFAULT_MODEL
                and dict(k[1]).get("version") == str(swap["version"])
                for k in labeled), labeled
+    admitted = [k for k in parsed["samples"]
+                if k[0] == "zoo_class_admitted_total"]
+    assert any(dict(k[1]).get("class") == "batch" for k in admitted), \
+        admitted
     assert parsed["types"]["zoo_model_requests_total"] == "counter"
     print(f"prometheus scrape OK ({len(parsed['samples'])} samples, "
           f"{len(names)} series names)")

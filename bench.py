@@ -1917,6 +1917,623 @@ def serving_bench(n_requests: int = 400, d_in: int = 64, d_hidden: int = 64,
     return 0
 
 
+# ====================================================================
+# loadtest: the standing traffic rig (ISSUE 6) — open-loop Poisson /
+# spike / ramp arrival profiles plus a closed-loop mode, driving the
+# elastic serving layer (autoscaler, priority fair-share admission,
+# p99 hedging) and gating its acceptance bars.
+# ====================================================================
+
+def _poisson_arrivals(rng, rate_hz: float, duration_s: float,
+                      t0: float, tag: str):
+    """Open-loop Poisson arrival offsets: exponential gaps at
+    ``rate_hz``, offset by ``t0``, tagged for later per-phase
+    accounting."""
+    out = []
+    t = t0
+    while True:
+        t += rng.exponential(1.0 / rate_hz)
+        if t >= t0 + duration_s:
+            return out
+        out.append((t, tag))
+
+
+def _ramp_arrivals(rng, rate0: float, rate1: float, duration_s: float,
+                   t0: float, tag: str):
+    """Linearly increasing arrival rate (thinning a Poisson stream at
+    the peak rate)."""
+    out = []
+    t = t0
+    while True:
+        t += rng.exponential(1.0 / rate1)
+        if t >= t0 + duration_s:
+            return out
+        frac = (t - t0) / duration_s
+        if rng.random() < (rate0 + (rate1 - rate0) * frac) / rate1:
+            out.append((t, tag))
+
+
+def _run_open_loop(issue_one, arrivals, n_workers: int = 24):
+    """Drive a sorted ``[(t_offset, tag), ...]`` schedule open-loop:
+    workers issue each request at its scheduled time REGARDLESS of
+    completions (a saturated server sees the backlog, not a politely
+    self-throttling client).  Returns per-request records
+    ``(t_issue, tag, outcome, latency_s)``."""
+    import threading
+
+    from analytics_zoo_tpu.serving import DeadlineExceeded, Overloaded
+
+    idx = [0]
+    lock = threading.Lock()
+    records = []
+    t0 = time.perf_counter()
+
+    def worker():
+        while True:
+            with lock:
+                i = idx[0]
+                if i >= len(arrivals):
+                    return
+                idx[0] += 1
+            t_sched, tag = arrivals[i]
+            delay = t0 + t_sched - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t_issue = time.perf_counter()
+            outcome = "ok"
+            try:
+                issue_one(tag)
+            except Overloaded:
+                outcome = "shed"
+            except DeadlineExceeded:
+                outcome = "deadline"
+            except Exception:  # noqa: BLE001 — counted, gated below
+                outcome = "error"
+            lat = time.perf_counter() - t_issue
+            with lock:
+                records.append((t_issue - t0, tag, outcome, lat))
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(n_workers)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    return records
+
+
+def _run_closed_loop(issue_one, per_class_workers, duration_s: float):
+    """Closed-loop mode: ``{class: n_workers}`` workers issue
+    back-to-back for ``duration_s``; a shed backs off 1 ms (so shed
+    accounting reflects sustained overload pressure, not a raw retry
+    storm).  Returns records ``(class, outcome, latency_s)``."""
+    import threading
+
+    from analytics_zoo_tpu.serving import DeadlineExceeded, Overloaded
+
+    records = []
+    lock = threading.Lock()
+    stop = time.perf_counter() + duration_s
+
+    def worker(cls):
+        mine = []
+        while time.perf_counter() < stop:
+            t0 = time.perf_counter()
+            outcome = "ok"
+            try:
+                issue_one(cls)
+            except Overloaded:
+                outcome = "shed"
+                time.sleep(0.001)
+            except DeadlineExceeded:
+                outcome = "deadline"
+            except Exception:  # noqa: BLE001
+                outcome = "error"
+            mine.append((cls, outcome, time.perf_counter() - t0))
+        with lock:
+            records.extend(mine)
+
+    threads = [threading.Thread(target=worker, args=(cls,))
+               for cls, n in per_class_workers.items()
+               for _ in range(n)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    return records
+
+
+def _lt_saturate(issue_one, stop):
+    """A closed-loop low-priority saturator worker: keeps one request
+    parked (weight-0 class → it waits until the high class leaves a
+    gap) and, once the queue is full, every further arrival sheds —
+    sustained overload pressure with a bounded shed-storm cost (the
+    backoff keeps 2 cores from burning on exception churn)."""
+    from analytics_zoo_tpu.serving import ServingError
+
+    while not stop.is_set():
+        try:
+            issue_one("lo")
+        except ServingError:
+            time.sleep(0.01)
+
+
+def _lt_params(np, n_layers: int = 96, d: int = 64):
+    rng = np.random.default_rng(7)
+    params = {f"w{i}": rng.normal(size=(d, d)).astype(np.float32) * 0.1
+              for i in range(n_layers)}
+
+    import jax.numpy as jnp
+
+    def mlp(p, x):
+        h = x
+        for i in range(n_layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return h
+
+    return mlp, params, d, rng
+
+
+def _lt_autoscale(np, quick: bool, selfcheck: bool, collectors,
+                  shape: str = "spike"):
+    """Open-loop run against an autoscaled deployment — ``shape`` is
+    the overload arrival profile: an abrupt 3x "spike" or a linear
+    "ramp" from 0.25x to 3x (same gates; the ramp exercises the
+    hysteresis on a GRADUAL signal instead of a step).  Gates: >=1
+    scale-up and >=1 scale-down, zero cold compiles across scale
+    events (one compile per bucket for the whole run), and no flapping
+    (consecutive transitions >= one cooldown apart)."""
+    from analytics_zoo_tpu.serving import (ModelRegistry,
+                                           autoscaler_for,
+                                           registry_collector)
+
+    mlp, params, d, rng = _lt_params(np)
+    reg = ModelRegistry(max_queue=128, max_concurrency=2,
+                        coalescing=True, replicas="all",
+                        supported_concurrent_num=2, max_batch_size=16,
+                        max_wait_ms=2.0)
+    reg.deploy("elastic", jax_fn=mlp, params=params, warmup_shapes=(d,))
+    collectors.append(registry_collector(reg))
+    entry = reg._entry("elastic")
+    model = entry.active.model
+    cooldown = 1.5 if quick else 2.5
+    scaler = autoscaler_for(reg, "elastic", min_replicas=1,
+                            up_queue_depth=4, down_queue_depth=1,
+                            hold_ticks=2, cooldown_s=cooldown,
+                            interval_s=0.1)
+    collectors.append(scaler.families)
+    scaler.apply_scale(1)  # start at the floor; the spike must earn 2
+    scaler.n_active = 1
+
+    # calibrate the spike to THIS box: closed-loop throughput at the
+    # 1-replica floor sets the rates (an absolute rps would be wrong
+    # on every other machine)
+    x = rng.normal(size=(1, d)).astype(np.float32)
+    cal = _run_closed_loop(lambda _c: reg.predict("elastic", x),
+                           {"cal": 4}, 1.5)
+    base_rps = sum(1 for r in cal if r[1] == "ok") / 1.5
+    base, surge, post = ((1.5, 3.5, 5.0) if quick else (3.0, 6.0, 8.0))
+    arr = rng
+    if shape == "ramp":
+        overload = _ramp_arrivals(arr, base_rps * 0.25, base_rps * 3.0,
+                                  surge, base, "ramp")
+    else:
+        overload = _poisson_arrivals(arr, base_rps * 3.0, surge, base,
+                                     "spike")
+    arrivals = sorted(
+        _poisson_arrivals(arr, max(base_rps * 0.25, 2.0), base, 0.0,
+                          "base")
+        + overload
+        + _poisson_arrivals(arr, max(base_rps * 0.15, 1.0), post,
+                            base + surge, "post"))
+    scaler.start()
+    records = _run_open_loop(lambda _c: reg.predict("elastic", x),
+                             arrivals)
+    # let the post-spike quiet window finish draining + scale down
+    deadline = time.perf_counter() + (post if quick else post + 2)
+    while time.perf_counter() < deadline:
+        if scaler.counters.get("scale_down") >= 1:
+            break
+        time.sleep(0.2)
+    scaler.stop()
+    events = scaler.events()
+    ups = [e for e in events if e["direction"] == "up"]
+    downs = [e for e in events if e["direction"] == "down"]
+    misses = reg.metrics("elastic")["elastic"]["serving"]["misses"]
+    outcomes = {}
+    for _, _, oc, _ in records:
+        outcomes[oc] = outcomes.get(oc, 0) + 1
+    res = {"shape": shape,
+           "profile_s": {"base": base, "surge": surge, "post": post},
+           "calibrated_floor_rps": round(base_rps, 1),
+           "arrivals": len(arrivals), "outcomes": outcomes,
+           "events": [{k: (round(v, 3) if isinstance(v, float) else v)
+                       for k, v in e.items()} for e in events],
+           "scale_up": len(ups), "scale_down": len(downs),
+           "cooldown_s": cooldown, "misses": misses}
+    ok = True
+    if selfcheck:
+        if not ups or not downs:
+            _log(f"loadtest FAIL: autoscale events up={len(ups)} "
+                 f"down={len(downs)} (need >=1 each)")
+            ok = False
+        if any(v != 1 for v in misses.values()):
+            _log(f"loadtest FAIL: a bucket compiled more than once "
+                 f"across scale events: {misses}")
+            ok = False
+        ts = [e["t"] for e in events]
+        gaps = [b - a for a, b in zip(ts, ts[1:])]
+        if any(g < cooldown * 0.95 for g in gaps):
+            _log(f"loadtest FAIL: flapping — transition gaps {gaps} "
+                 f"under cooldown {cooldown}")
+            ok = False
+        if outcomes.get("error"):
+            _log(f"loadtest FAIL: {outcomes['error']} request errors")
+            ok = False
+    for e in events:
+        _log(f"LOADTEST_AUTOSCALE_EVENT {e['direction']} "
+             f"{e['from_replicas']}->{e['to_replicas']} "
+             f"t={e['t'] - events[0]['t']:.2f}s "
+             f"queue={e['queue_depth']:.0f}")
+    print(f"LOADTEST_AUTOSCALE up={len(ups)} down={len(downs)}",
+          flush=True)
+    return res, ok, reg
+
+
+def _lt_priority(np, quick: bool, selfcheck: bool, collectors):
+    """2x-overload run with two tenants: the high class arrives
+    OPEN-LOOP at a fixed rate well under capacity (its offered load
+    must not flex with latency, or the ratio measures host contention
+    instead of admission policy), the low class is a closed-loop
+    saturator providing the overload.  Gates: shed requests come
+    EXCLUSIVELY from the low class (exact count), zero admitted
+    requests dropped, and high-class SLO goodput under overload within
+    10% of the SAME arrival schedule served uncontended (best of a few
+    attempts — separate runs on the 2-core box carry scheduler
+    noise)."""
+    import threading
+
+    from analytics_zoo_tpu.serving import (ModelRegistry,
+                                           registry_collector)
+
+    mlp, params, d, rng = _lt_params(np)
+    reg = ModelRegistry(max_queue=8, max_concurrency=2,
+                        coalescing=True, replicas="all",
+                        supported_concurrent_num=2, max_batch_size=16,
+                        priority_classes={"hi": (10, 1.0),
+                                          "lo": (0, 0.0)})
+    reg.deploy("tenants", jax_fn=mlp, params=params, warmup_shapes=(d,))
+    collectors.append(registry_collector(reg))
+    x = rng.normal(size=(1, d)).astype(np.float32)
+
+    def issue(cls):
+        reg.predict("tenants", x, priority_class=cls)
+
+    # calibrate capacity, then fix the hi class's offered load at 40%
+    # of it — comfortably under capacity, so "uncontended goodput"
+    # is simply that rate served within SLO
+    cal = _run_closed_loop(issue, {"hi": 4}, 1.5)
+    cap_rps = sum(1 for r in cal if r[1] == "ok") / 1.5
+    hi_rate = max(cap_rps * 0.4, 5.0)
+    dur = 2.0 if quick else 3.5
+    slo_ms = 250.0
+    attempts = 3
+    best = None
+    for attempt in range(attempts):
+        # per-attempt baseline: the controller's counters are
+        # cumulative, so the shed gates must read THIS attempt's
+        # deltas — a transient shed in a discarded early attempt must
+        # not fail the winning clean one (best-of-N exists precisely
+        # to absorb scheduler noise on the 2-core box)
+        snap_pre = reg._entry("tenants").admission.snapshot()
+        hi_sched = _poisson_arrivals(np.random.default_rng(41),
+                                     hi_rate, dur, 0.0, "hi")
+        # clean pass: the identical schedule, nobody else on the box
+        clean = _run_open_loop(issue, hi_sched, n_workers=8)
+        un_good = sum(1 for _, _, oc, lat in clean
+                      if oc == "ok" and lat * 1e3 <= slo_ms) / dur
+        # overload pass: same schedule + a closed-loop low-priority
+        # saturator (each worker parks one waiter; beyond the queue
+        # bound every further arrival sheds — sustained 2x+ pressure)
+        stop = threading.Event()
+        lo_threads = [threading.Thread(
+            target=_lt_saturate, args=(issue, stop))
+            for _ in range(8)]
+        [t.start() for t in lo_threads]
+        time.sleep(0.1)  # let the lo queue fill before hi arrives
+        mixed = _run_open_loop(issue, hi_sched, n_workers=8)
+        stop.set()
+        [t.join() for t in lo_threads]
+        hi_good = sum(1 for _, _, oc, lat in mixed
+                      if oc == "ok" and lat * 1e3 <= slo_ms) / dur
+        ratio = hi_good / max(un_good, 1e-9)
+        snap = reg._entry("tenants").admission.snapshot()
+        shed_split = {
+            cls: (snap["classes"][cls]["shed"]
+                  - snap_pre["classes"][cls]["shed"])
+            for cls in ("hi", "lo")}
+        shed_split["total"] = shed_split["hi"] + shed_split["lo"]
+        if best is None or ratio > best["goodput_ratio"]:
+            best = {
+                "capacity_rps": round(cap_rps, 1),
+                "hi_offered_rps": round(hi_rate, 1),
+                "uncontended_hi_goodput_rps": round(un_good, 1),
+                "overload_hi_goodput_rps": round(hi_good, 1),
+                "goodput_ratio": round(ratio, 3),
+                "slo_ms": slo_ms, "duration_s": dur,
+                "hi_overload_outcomes": {
+                    oc: sum(1 for _, _, o, _ in mixed if o == oc)
+                    for oc in ("ok", "shed", "deadline", "error")},
+                "classes": snap["classes"],
+                "shed_split": shed_split,
+                "admitted": snap["admitted"],
+                "completed": snap["completed"],
+                "errors": snap["errors"], "attempt": attempt + 1,
+            }
+        if best["goodput_ratio"] >= 0.9 \
+                and best["shed_split"]["hi"] == 0:
+            break
+    ok = True
+    if selfcheck:
+        if best["shed_split"]["hi"] != 0:
+            _log(f"loadtest FAIL: {best['shed_split']['hi']} "
+                 "high-priority requests shed while low-priority "
+                 "waiters existed")
+            ok = False
+        if best["shed_split"]["lo"] <= 0:
+            _log("loadtest FAIL: 2x overload shed nothing — the run "
+                 "never actually overloaded")
+            ok = False
+        if best["errors"] != 0 or \
+                best["admitted"] != best["completed"] + best["errors"]:
+            _log(f"loadtest FAIL: admitted {best['admitted']} != "
+                 f"completed {best['completed']} — an admitted "
+                 "request was dropped")
+            ok = False
+        if best["goodput_ratio"] < 0.9:
+            _log(f"loadtest FAIL: hi-class goodput under overload is "
+                 f"{best['goodput_ratio']:.3f}x its uncontended rate "
+                 "(< 0.9x)")
+            ok = False
+    _log(f"loadtest priority: hi goodput {best['goodput_ratio']:.3f}x "
+         f"uncontended under 2x overload, shed hi/lo = "
+         f"{best['shed_split']['hi']}/{best['shed_split']['lo']}")
+    return best, ok, reg
+
+
+def _lt_hedge(np, quick: bool, selfcheck: bool, collectors):
+    """Interleaved hedging-on vs hedging-off run with one straggling
+    replica.  Hard gates: bit-exact results regardless of which
+    dispatch wins, hedges actually fired and won, sanitizer-clean
+    warmed loop.  The p99 ratio is INFORMATIONAL on the 2-core box
+    (perf-flake policy: forced host devices share two cores)."""
+    import threading
+
+    from analytics_zoo_tpu.serving import (ModelRegistry,
+                                           registry_collector)
+    from analytics_zoo_tpu.tools.zoolint import sanitize
+
+    mlp, params, d, rng = _lt_params(np, n_layers=48)
+    reg = ModelRegistry(max_queue=256, max_concurrency=4,
+                        coalescing=True, replicas=2,
+                        supported_concurrent_num=2, max_batch_size=16,
+                        hedging=True, hedge_quantile=0.95,
+                        hedge_min_ms=1.0)
+    reg.deploy("hedged", jax_fn=mlp, params=params, warmup_shapes=(d,))
+    collectors.append(registry_collector(reg))
+    hedge_im = reg._entry("hedged").active.model
+
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    plain_im = InferenceModel(supported_concurrent_num=2,
+                              max_batch_size=16, coalescing=True,
+                              replicas=2)
+    plain_im.load_jax(mlp, params)
+    plain_im.warmup((d,))
+
+    x = rng.normal(size=(1, d)).astype(np.float32)
+    ref = np.asarray(hedge_im.predict(x)).copy()
+    # seed both hedge-latency windows on the healthy distribution
+    for _ in range(40):
+        hedge_im.predict(x)
+        plain_im.predict(x)
+
+    # one straggling replica, injected identically into both models:
+    # slot 0's fetch sleeps (the host-visible symptom of a slow chip)
+    delay_s = 0.03
+    for im in (hedge_im, plain_im):
+        coal = im._coalescer
+        orig = coal._fetch_slot
+
+        def slow(dev, n, slot, _orig=orig):
+            if slot == 0:
+                time.sleep(delay_s)
+            return _orig(dev, n, slot)
+
+        coal._fetch_slot = slow
+
+    n_req = 120 if quick else 240
+    lat = {"hedged": [], "plain": []}
+    lock = threading.Lock()
+    errs = []
+
+    def worker(tid):
+        mine = {"hedged": [], "plain": []}
+        for k in range(n_req // 8):
+            for side, im in (("hedged", hedge_im), ("plain", plain_im)):
+                t0 = time.perf_counter()
+                try:
+                    out = im.predict(x)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+                    continue
+                mine[side].append(time.perf_counter() - t0)
+                if not np.array_equal(np.asarray(out), ref):
+                    errs.append(f"{side} result mismatch")
+        with lock:
+            lat["hedged"].extend(mine["hedged"])
+            lat["plain"].extend(mine["plain"])
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+
+    def p(vals, pct):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1,
+                        int(round(pct / 100 * (len(vals) - 1))))] * 1e3
+
+    hedges = hedge_im._coalescer.hedge_stats()
+    res = {"delay_ms": delay_s * 1e3, "requests_per_side": n_req,
+           "hedged_p50_ms": round(p(lat["hedged"], 50), 2),
+           "hedged_p99_ms": round(p(lat["hedged"], 99), 2),
+           "plain_p50_ms": round(p(lat["plain"], 50), 2),
+           "plain_p99_ms": round(p(lat["plain"], 99), 2),
+           "hedges": hedges, "errors": errs[:5]}
+    res["p99_ratio_hedged_vs_plain"] = round(
+        res["hedged_p99_ms"] / max(res["plain_p99_ms"], 1e-9), 3)
+    ok = True
+    if selfcheck:
+        if errs:
+            _log(f"loadtest FAIL: hedging run errors/mismatches: "
+                 f"{errs[:3]}")
+            ok = False
+        if not (hedges["fired"] > 0 and hedges["hedge_won"] > 0):
+            _log(f"loadtest FAIL: hedging never fired/won against a "
+                 f"{delay_s * 1e3:.0f} ms straggler: {hedges}")
+            ok = False
+        # sanitizer: the warmed hedging loop must be compile- and
+        # implicit-transfer-clean (hedge re-dispatch included)
+        try:
+            with sanitize(max_compiles=0):
+                for _ in range(24):
+                    hedge_im.predict(x)
+            res["sanitize_clean"] = True
+        except Exception as e:  # noqa: BLE001
+            res["sanitize_clean"] = False
+            _log(f"loadtest FAIL: sanitizer violation in the hedging "
+                 f"hot loop: {type(e).__name__}: {e}")
+            ok = False
+    msg = ("improved" if res["p99_ratio_hedged_vs_plain"] < 1.0
+           else "did not improve")
+    _log(f"loadtest hedging: p99 hedged {res['hedged_p99_ms']:.1f} ms "
+         f"vs plain {res['plain_p99_ms']:.1f} ms "
+         f"({res['p99_ratio_hedged_vs_plain']:.2f}x, {msg}; "
+         f"informational on this box), hedges {hedges}")
+    plain_im.close()
+    return res, ok, reg
+
+
+def _write_loadtest_trajectory(results: dict, rc: int) -> str:
+    """Append this run to the BENCH_LOADTEST_r*.json trajectory (same
+    shape as the driver's BENCH_r*.json files: n / cmd / rc / parsed),
+    so loadtest baselines accumulate across PRs."""
+    import re as _re
+
+    ns = []
+    for p in glob.glob(os.path.join(REPO, "BENCH_LOADTEST_r*.json")):
+        m = _re.search(r"BENCH_LOADTEST_r(\d+)\.json$", p)
+        if m:
+            ns.append(int(m.group(1)))
+    n = max(ns, default=0) + 1
+    path = os.path.join(REPO, f"BENCH_LOADTEST_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"n": n,
+                   "cmd": "python bench.py loadtest "
+                          + " ".join(sys.argv[2:]),
+                   "rc": rc, "parsed": results}, f, indent=2)
+    return path
+
+
+def loadtest_bench(profile: str = "all", selfcheck: bool = False,
+                   quick: bool = False, out_path: str = None) -> int:
+    """The standing traffic rig: spike- and ramp-profile autoscaling,
+    2x-overload priority fair-share, and straggler hedging — each
+    section builds its own registry, all feed ONE Prometheus surface
+    whose scrape is round-tripped through the stdlib parser (new
+    families included).  ``--quick`` shortens every phase for the CI
+    smoke gate."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from analytics_zoo_tpu.observability import (MetricsRegistry,
+                                                 parse_prometheus_text)
+
+    results = {"profile": profile, "quick": quick}
+    collectors = []
+    registries = []
+    ok = True
+    def _lt_ramp(np_, quick_, selfcheck_, collectors_):
+        return _lt_autoscale(np_, quick_, selfcheck_, collectors_,
+                             shape="ramp")
+
+    sections = {
+        "autoscale": _lt_autoscale,
+        "ramp": _lt_ramp,
+        "priority": _lt_priority,
+        "hedge": _lt_hedge,
+    }
+    # "spike" is the smoke-gate alias: just the spike-shape autoscale
+    # section (short, deterministic thresholds)
+    run = (list(sections) if profile == "all"
+           else ["autoscale"] if profile == "spike"
+           else [profile])
+    for name in run:
+        if name not in sections:
+            _log(f"loadtest: unknown profile {name!r} "
+                 f"(use {sorted(sections)} or 'all')")
+            return 2
+        res, sec_ok, reg = sections[name](np, quick, selfcheck,
+                                          collectors)
+        results[name] = res
+        registries.append(reg)
+        if selfcheck and not sec_ok:
+            ok = False
+
+    # ---- the unified scrape: every new family, parser-clean
+    mreg = MetricsRegistry()
+    for c in collectors:
+        mreg.register_collector(c)
+    text = mreg.render_prometheus()
+    try:
+        parsed = parse_prometheus_text(text)
+        names = {k[0] for k in parsed["samples"]}
+        required = {"zoo_shed_total", "zoo_class_admitted_total"}
+        if "autoscale" in results or "ramp" in results:
+            required |= {"zoo_autoscale_events_total",
+                         "zoo_model_replicas_active"}
+        if "hedge" in results:
+            required |= {"zoo_hedge_total"}
+        missing = sorted(required - names)
+        if missing:
+            _log(f"loadtest FAIL: families missing from the scrape: "
+                 f"{missing}")
+            ok = False
+        else:
+            print(f"LOADTEST_SCRAPE_OK samples={len(parsed['samples'])}"
+                  f" families={len(names)}", flush=True)
+        results["scrape"] = {"samples": len(parsed["samples"]),
+                             "families": sorted(
+                                 n for n in names
+                                 if n in required)}
+    except ValueError as e:
+        _log(f"loadtest FAIL: unparseable exposition: {e}")
+        ok = False
+    for reg in registries:
+        reg.shutdown()
+
+    print("BENCH_LOADTEST " + json.dumps(results), flush=True)
+    rc = 0 if (ok or not selfcheck) else 1
+    if profile == "all":
+        # only full runs enter the trajectory — a partial/smoke run
+        # would archive an incomparable baseline
+        path = _write_loadtest_trajectory(results, rc)
+        _log(f"loadtest trajectory written: {os.path.basename(path)}")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+    if selfcheck:
+        print("LOADTEST_SELFCHECK_" + ("OK" if ok else "FAIL"),
+              flush=True)
+    return rc
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
@@ -1938,5 +2555,24 @@ if __name__ == "__main__":
             out = sys.argv[sys.argv.index("--out") + 1]
         sys.exit(serving_bench(selfcheck="--selfcheck" in sys.argv,
                                out_path=out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "loadtest":
+        # the elastic gates need >1 device: force 2 virtual host
+        # devices BEFORE jax initializes (no-op when the caller — the
+        # smoke script, a real-TPU run — already set a count)
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+        _prof = "all"
+        if "--profile" in sys.argv:
+            _prof = sys.argv[sys.argv.index("--profile") + 1]
+        _out = None
+        if "--out" in sys.argv:
+            _out = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(loadtest_bench(profile=_prof,
+                                selfcheck="--selfcheck" in sys.argv,
+                                quick="--quick" in sys.argv,
+                                out_path=_out))
     else:
         sys.exit(main())

@@ -34,4 +34,28 @@ grep -q "replica check: 2 replicas" <<<"$out" || {
     echo "smoke FAIL: self-test never verified multi-replica serving" >&2
     exit 1
 }
+
+# Elastic serving gate: a short spike-profile loadtest under the same
+# 2 forced host devices — the autoscaler must scale up INTO the spike
+# and back down after it (zero cold compiles across both transitions,
+# no flapping: the selfcheck enforces all three), and the Prometheus
+# scrape carrying the new families (zoo_autoscale_events_total,
+# zoo_shed_total{class}, zoo_model_replicas_active, ...) must
+# round-trip the stdlib parser.
+lt=$(timeout -k 10 360 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python bench.py loadtest --profile spike --quick --selfcheck)
+printf '%s\n' "$lt"
+grep -Eq "LOADTEST_AUTOSCALE up=[1-9][0-9]* down=[1-9]" <<<"$lt" || {
+    echo "smoke FAIL: spike loadtest missing a scale-up + scale-down" >&2
+    exit 1
+}
+grep -q "LOADTEST_SCRAPE_OK" <<<"$lt" || {
+    echo "smoke FAIL: loadtest scrape of the elastic families failed" >&2
+    exit 1
+}
+grep -q "LOADTEST_SELFCHECK_OK" <<<"$lt" || {
+    echo "smoke FAIL: loadtest selfcheck gates failed" >&2
+    exit 1
+}
 echo "serving smoke OK"

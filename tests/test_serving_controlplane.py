@@ -688,3 +688,53 @@ def test_multi_model_isolation():
         m = reg.metrics()
         assert m["a"]["swap_count"] == 0
         assert m["b"]["swap_count"] == 1
+
+
+# ------------------------------------ elasticity satellites (ISSUE 6)
+def test_ewma_resets_on_activation_swap_then_admit():
+    """A slow v1 seeds the service-time EWMA; activating a fast v2
+    must reset it, or v2 would predictively shed deadline requests it
+    could easily meet (the estimate describes the RETIRED model)."""
+    reg = ModelRegistry(max_queue=8, max_concurrency=1)
+    reg.deploy("m", model=_SlowModel(service_s=0.08))
+    for _ in range(3):  # seed the EWMA with the slow version
+        reg.predict("m", np.ones(2))
+    entry = reg._entry("m")
+    assert entry.admission.snapshot()["service_ewma_ms"] > 50
+
+    reg.deploy("m", model=_SlowModel(service_s=0.0))  # the fast v2
+    snap = entry.admission.snapshot()
+    assert snap["service_ewma_ms"] is None, snap
+    # swap-then-admit: a deadline v1 could never meet sails through
+    # (predictive shedding has nothing stale to predict from)
+    out = reg.predict("m", np.ones(2), deadline_ms=20)
+    assert out is not None
+    assert entry.admission.snapshot()["shed_deadline"] == 0
+    # promote() resets too, not just direct activation
+    reg.deploy("m", model=_SlowModel(service_s=0.06),
+               canary_fraction=0.5)
+    for _ in range(4):
+        reg.predict("m", np.ones(2))
+    assert entry.admission.snapshot()["service_ewma_ms"] is not None
+    reg.promote("m")
+    assert entry.admission.snapshot()["service_ewma_ms"] is None
+    reg.shutdown()
+
+
+def test_registry_priority_class_plumbs_through_admission():
+    """predict_ex(priority_class=...) reaches the model's admission
+    controller: per-class admitted counters move, and the classes from
+    the registry-level config exist on every model's controller."""
+    reg = ModelRegistry(max_queue=4, max_concurrency=2,
+                        priority_classes={"interactive": (10, 0.9),
+                                          "batch": (0, 0.1)})
+    reg.deploy("m", model=_SlowModel(service_s=0.0))
+    reg.predict("m", np.ones(2), priority_class="batch")
+    out, info = reg.predict_ex("m", np.ones(2),
+                               priority_class="interactive")
+    assert info["version"] == 1
+    classes = reg._entry("m").admission.snapshot()["classes"]
+    assert classes["batch"]["admitted"] == 1
+    assert classes["interactive"]["admitted"] == 1
+    assert classes["interactive"]["priority"] == 10
+    reg.shutdown()

@@ -24,6 +24,7 @@ multi-device topology on plain CPU.
 import json
 import logging
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -292,8 +293,12 @@ class _CrashingExecutable:
 
 
 def _sabotage_replica(im, index):
-    """Replace every placed executable of one replica with a crasher."""
+    """Replace every placed executable of one replica with a crasher.
+    Probes are frozen (huge backoff) so the tests pinning
+    routes-around-the-dead-replica behavior aren't racing the health
+    re-probe — the recovery tests re-arm it explicitly."""
     rs = im._cache.replica_set
+    rs.probe_backoff_s = 3600.0
     crashers = []
     for key in list(rs._exes):
         exes = list(rs._exes[key])
@@ -552,3 +557,85 @@ def test_span_carries_replica_label():
         assert "replica" in tr["labels"], tr["labels"]
         assert tr["labels"]["replica"] in (0, 1)
         assert "bucket" in tr["labels"]
+
+
+# ----------------------------------------- health re-probe (ISSUE 6)
+def test_replica_crash_then_heals_via_reprobe():
+    """Recovery is structured, not luck: a replica marked unhealthy by
+    a crash is re-probed with a cheap warmed no-op execute once its
+    backoff lapses, and a probe that returns flips it healthy — the
+    zoo_replica_unhealthy gauge goes back to 0 without a hot-swap."""
+    im = InferenceModel(supported_concurrent_num=2, max_batch_size=8,
+                        coalescing=True, max_wait_ms=1.0, replicas=2)
+    im.load_jax(lambda p, x: x * p["s"], {"s": np.float32(2.0)})
+    im.warmup((4,))
+    originals = dict(im._cache.replica_set._exes)  # pre-sabotage
+    rs, _ = _sabotage_replica(im, 1)
+
+    x = np.ones((2, 4), np.float32)
+    for _ in range(8):  # round-robin reaches the crasher in <= 2
+        np.testing.assert_array_equal(im.predict(x), 2.0 * x)
+        if not rs.replicas[1].healthy:
+            break
+    assert im.serving_stats()["replica_unhealthy"][1] is True
+    sick = rs.replicas[1]
+    first_backoff = sick.probe_backoff
+
+    # the fault clears (the "device" comes back): restore the real
+    # executables and make the probe due NOW
+    with rs._lock:
+        for key, exes in originals.items():
+            rs._exes[key] = exes
+        rs.probe_backoff_s = 0.01
+        sick.probe_at = 0.0
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not sick.healthy:
+        im.predict(x)  # the dispatcher loop drives maybe_reprobe
+        time.sleep(0.01)
+    assert sick.healthy, "probe never restored the recovered replica"
+    stats = im.serving_stats()
+    assert stats["replica_unhealthy"] == {0: False, 1: False}, stats
+    assert sick.probe_backoff == rs.probe_backoff_s  # backoff reset
+    # healed means scheduled: traffic reaches replica 1 again
+    before = rs.replicas[1].dispatches
+    for i in range(12):
+        np.testing.assert_array_equal(im.predict(x), 2.0 * x)
+    assert rs.replicas[1].dispatches > before
+    # the exported gauge agrees
+    reg_snapshot = {"m": {"active_version": 1, "swap_count": 0,
+                          "admission": {}, "versions": {},
+                          "serving": stats}}
+    fams = {f.name: f for f in registry_families(reg_snapshot)}
+    vals = [v for lbl, v in fams["zoo_replica_unhealthy"].samples]
+    assert vals == [0, 0], vals
+    im.close()
+
+
+def test_failed_probe_doubles_backoff():
+    """A probe against a still-dead replica must back off
+    exponentially — not hammer a sick device at the probe interval."""
+    im = InferenceModel(supported_concurrent_num=1, max_batch_size=4,
+                        coalescing=False, replicas=2)
+    im.load_jax(lambda p, x: x * p["s"], {"s": np.float32(1.0)})
+    im.warmup((4,))
+    rs, _ = _sabotage_replica(im, 1)
+    rs.mark_unhealthy(rs.replicas[1], RuntimeError("injected"))
+    sick = rs.replicas[1]
+    with rs._lock:
+        sick.probe_backoff = rs.probe_backoff_s = 0.01
+    seen = []
+    for _ in range(3):
+        prev = sick.probe_backoff
+        with rs._lock:
+            sick.probe_at = 0.0
+        rs.maybe_reprobe()
+        # probes run on a detached daemon thread now — wait for it
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline \
+                and sick.probe_backoff == prev and not sick.healthy:
+            time.sleep(0.005)
+        seen.append(sick.probe_backoff)
+        assert not sick.healthy  # the crasher is still installed
+    assert seen[0] < seen[1] < seen[2], seen  # doubling, not constant
+    assert seen[-1] <= rs.probe_backoff_max_s
+    im.close()
