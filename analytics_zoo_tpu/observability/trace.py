@@ -43,9 +43,13 @@ from typing import Any, Dict, List, Optional
 
 from .metrics import Family
 
-#: the canonical request phase order (docs/observability.md)
+#: the canonical request phase order (docs/observability.md).  The
+#: first six are the one-shot predict chain; the last three belong to
+#: the continuous-batching generate path (decode_wait covers the
+#: engine queue, prefill the bucketed prompt pass + slot insert,
+#: decode_step the whole shared-step participation until eviction).
 PHASES = ("admission_queue", "coalesce_wait", "pad", "device_put",
-          "execute", "depad")
+          "execute", "depad", "decode_wait", "prefill", "decode_step")
 
 _SPAN_VAR: "contextvars.ContextVar[Optional[Span]]" = \
     contextvars.ContextVar("zoo_tpu_span", default=None)

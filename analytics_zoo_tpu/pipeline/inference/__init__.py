@@ -1,3 +1,4 @@
+from .decode import DecodeEngine, DecodeEngineClosedError, TokenStream
 from .inference_model import InferenceModel, AbstractInferenceModel, JTensor
 from .serving import (BucketedExecutableCache, CoalescerClosedError,
                       Replica, ReplicaSet, RequestCoalescer, bucket_ladder)

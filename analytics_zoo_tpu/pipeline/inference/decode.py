@@ -1,0 +1,838 @@
+"""Continuous batching for autoregressive decode (ORCA-style).
+
+The serving stack batches fixed-shape one-shot requests; the dominant
+LLM workload is token streaming, where requests join and leave the
+batch at EVERY decode step.  Naive batch-of-requests decoding makes
+every rider pay the longest sequence's latency: a batch finishes when
+its slowest member does, and short requests idle in finished rows.
+
+``DecodeEngine`` is the iteration-level alternative:
+
+* **Bucketed prefill.**  Each admitted prompt is right-padded to a
+  small geometric ladder of prompt lengths and run through ONE batched
+  causal forward (the training-shaped compute), writing its per-layer
+  K/V into a free slot of the decode state — one ``admit`` executable
+  per (prompt bucket, capacity), compiled once.
+* **A single persistent slot-array decode executable.**  The decode
+  state is a fixed-capacity slot array — per-layer K/V caches of shape
+  ``(capacity, heads, max_len, d_head)`` plus per-slot current token
+  and write position — stepped by ONE jitted function whose shapes
+  never depend on occupancy.  Attention masks derive from per-slot
+  positions, so occupied and free slots coexist in the same dispatch:
+  admission and eviction are state writes, never recompiles.  Exactly
+  one compile per (bucket, capacity) across a whole serving run — the
+  zoolint sanitizer's compile counter pins this at every occupancy.
+* **Per-step admission / eviction.**  A dispatcher thread loops:
+  drain finished slots (EOS or max tokens), admit queued requests into
+  free slots, step once, fan the step's tokens out to per-request
+  :class:`TokenStream` futures.  A short request admitted next to a
+  long one leaves as soon as ITS tokens are done; the freed slot is
+  re-filled on the very next iteration.
+
+Decode math: :mod:`analytics_zoo_tpu.models.generation`'s
+``_prefill`` / ``_decode_step`` — the same per-row-position (ragged)
+formulation ``TransformerLM.generate`` compiles into its scan, so a
+slot stepped one token at a time is pinned token-identical to the
+scan path (tests/test_serving_decode.py).  Greedy only: iteration-level
+scheduling interleaves unrelated requests in one dispatch, and greedy
+argmax is the one sampling mode whose per-slot stream provably cannot
+depend on its neighbors.
+
+Data movement is explicit (``device_put`` in, ``device_get`` out) so
+the whole loop runs clean under ``zoolint.sanitize()`` transfer
+guards; the decode state itself never leaves the device — the per-step
+host traffic is one (capacity,) token fetch.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...models.generation import (_decode_step, _embed_token,
+                                  _head_logits, _prefill)
+from ...observability import profile as _profile
+from ...observability.log import get_logger as _get_logger
+from .serving import bucket_ladder
+
+_slog = _get_logger("zoo.serving.decode")
+
+
+class DecodeEngineClosedError(RuntimeError):
+    """The decode dispatcher is gone — this request was (or would be)
+    never served."""
+
+
+class TokenStream:
+    """Per-request streaming handle: tokens arrive one decode step at a
+    time; iterate for streaming, or :meth:`result` for the full
+    continuation.
+
+    Thread contract: the engine's dispatcher is the only writer; any
+    number of consumer threads may iterate / ``result()``.  The
+    producer fast path is ONE list append (GIL-atomic) — the condition
+    variable is only touched once a consumer actually iterates
+    (``_live``), so blocking callers cost the dispatcher nothing per
+    token.  This is hot-loop-relevant: at thousands of tokens/s a
+    locked queue put per token was ~15% of the engine's wall.
+    """
+
+    def __init__(self, request_id: int):
+        self.request_id = request_id
+        self._tokens: List[int] = []
+        self._error: Optional[BaseException] = None
+        self._finished = threading.Event()
+        self._live = False  # a consumer is iterating — notify pushes
+        self._cond = threading.Condition()
+
+    # ---- producer side (dispatcher thread only) ----
+    def _push(self, tok: int):
+        self._tokens.append(tok)
+        if self._live:
+            with self._cond:
+                self._cond.notify_all()
+
+    def _finish(self, error: Optional[BaseException] = None):
+        self._error = error
+        self._finished.set()
+        if self._live:
+            with self._cond:
+                self._cond.notify_all()
+
+    # ---- consumer side ----
+    @property
+    def done(self) -> bool:
+        return self._finished.is_set()
+
+    def __iter__(self):
+        self._live = True
+        i = 0
+        while True:
+            # catch up lock-free (append-only list, single writer)
+            while i < len(self._tokens):
+                yield int(self._tokens[i])
+                i += 1
+            if self._finished.is_set():
+                if i < len(self._tokens):
+                    continue  # tokens landed after the done flag
+                if self._error is not None:
+                    raise self._error
+                return
+            with self._cond:
+                if i >= len(self._tokens) \
+                        and not self._finished.is_set():
+                    # bounded wait: _live may have been observed False
+                    # by a push racing this first iteration
+                    self._cond.wait(0.05)
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the request finishes; returns the generated
+        continuation as a 1-D int32 array (EOS included when hit)."""
+        if not self._finished.wait(timeout=timeout):
+            raise TimeoutError(
+                f"decode request {self.request_id} still streaming "
+                f"after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return np.asarray(self._tokens, np.int32)
+
+
+class _DecodeRequest:
+    # ``span`` is the explicit cross-thread trace handoff (same
+    # convention as the coalescer's _Request): the dispatcher records
+    # prefill/decode_step phases on it directly.
+    # ``scheduled`` counts tokens covered by dispatched (possibly not
+    # yet processed) steps — the pipelined loop plans fused windows
+    # from it, since ``produced`` lags by the in-flight dispatch.
+    __slots__ = ("prompt", "length", "bucket", "max_new", "eos_id",
+                 "stream", "span", "produced", "scheduled", "slot")
+
+    def __init__(self, prompt: np.ndarray, length: int, bucket: int,
+                 max_new: int, eos_id: Optional[int], stream: TokenStream,
+                 span=None):
+        self.prompt = prompt
+        self.length = length
+        self.bucket = bucket
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.stream = stream
+        self.span = span
+        self.produced = 0
+        self.scheduled = 0
+        self.slot = -1
+
+
+_SHUTDOWN = object()
+
+
+class DecodeEngine:
+    """KV-cache-slotted continuous-batching decode engine (module doc).
+
+    Args:
+        params: the TransformerLM param tree (``trainer.state.params``)
+            — placed on ``device`` once at construction.
+        hyper: the model's hyper dict (``n_layers``/``n_heads``/
+            ``d_model``/``max_len``/``moe_every``...).
+        capacity: decode slots — the fixed batch width of the
+            persistent step executable.
+        max_len: per-slot cache length (default the model's
+            ``max_len``); every request needs
+            ``prompt_len + max_new_tokens <= max_len``.
+        prompt_buckets: the prompt-length ladder (default: a geometric
+            ladder up to ``max_len - 1``).  One admit executable
+            compiles per bucket actually used.
+        eos_id: default end-of-sequence token id (per-request
+            override via ``submit``); ``None`` decodes to
+            ``max_new_tokens`` always.
+        max_queue: bound on submitted-but-unadmitted requests.
+        step_fuse: fused-window size K — when no admission or
+            eviction could land inside the next K steps, they
+            dispatch as ONE compiled scan, amortizing per-dispatch
+            overhead without giving up iteration-level scheduling
+            (1 disables fusion; see ``_choose_fuse``).
+        device: jax device for the decode state (default: the first
+            local device).
+    """
+
+    def __init__(self, params, hyper: Dict[str, Any], capacity: int = 8,
+                 max_len: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None, max_queue: int = 256,
+                 step_fuse: int = 4, device=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.step_fuse = max(1, int(step_fuse))
+        self._hyper = dict(hyper)
+        self.max_len = int(max_len or hyper["max_len"])
+        if self.max_len > int(hyper["max_len"]):
+            raise ValueError(
+                f"max_len ({self.max_len}) exceeds the model's "
+                f"positional table ({hyper['max_len']})")
+        if prompt_buckets:
+            self.prompt_buckets: Tuple[int, ...] = tuple(
+                sorted(set(int(b) for b in prompt_buckets)))
+        else:
+            top = max(1, self.max_len - 1)
+            self.prompt_buckets = bucket_ladder(
+                top, growth=2.0, min_batch=min(8, top))
+        if self.prompt_buckets[-1] >= self.max_len:
+            raise ValueError(
+                f"largest prompt bucket ({self.prompt_buckets[-1]}) "
+                f"must leave room to decode (max_len {self.max_len})")
+        self.eos_id = eos_id
+        self._device = device or jax.local_devices()[0]
+        self._params = jax.device_put(params, self._device)
+        self._n_layers = int(hyper["n_layers"])
+
+        # ---- device state: the persistent slot array.  jnp.zeros
+        # builds ON the device (a fill, not a transfer); tok/pos for
+        # free slots are don't-cares — their writes land in cache
+        # positions a future occupant always overwrites before
+        # attending (write-then-attend, see _build_step_fn).
+        d_head = int(hyper["d_model"]) // int(hyper["n_heads"])
+        shape = (self.capacity, int(hyper["n_heads"]), self.max_len,
+                 d_head)
+        with jax.default_device(self._device):
+            caches = [(jnp.zeros(shape, jnp.float32),
+                       jnp.zeros(shape, jnp.float32))
+                      for _ in range(self._n_layers)]
+            tok = jnp.zeros((self.capacity,), jnp.int32)
+            pos = jnp.zeros((self.capacity,), jnp.int32)
+        # COMMIT the initial state (device_put of an on-device array is
+        # a no-op copy-wise but flips it committed): the live loop's
+        # state is always committed — its producers take committed
+        # device_put inputs — and the jit cache keys on committedness,
+        # so an uncommitted first call would cost every admit plan a
+        # SECOND compile the first time it sees steady-state inputs,
+        # breaking the one-compile-per-(bucket, capacity) invariant
+        self._caches = jax.device_put(caches, self._device)
+        self._tok = jax.device_put(tok, self._device)
+        self._pos = jax.device_put(pos, self._device)
+
+        # one jitted single-step plan plus a halving ladder of fused
+        # window plans (step_fuse, step_fuse/2, ... 2) per engine; one
+        # jitted admit per prompt bucket — all built OUTSIDE the
+        # dispatcher loop (zoolint ZL101) and cached, so a serving run
+        # compiles exactly once per (bucket, capacity) plan no matter
+        # how occupancy moves
+        self._step_fn = self._build_step_fn()
+        self._fuse_sizes: Tuple[int, ...] = tuple(
+            sorted({k for k in (self.step_fuse, self.step_fuse // 2)
+                    if k > 1}, reverse=True))
+        self._stepk_fns = {k: self._build_stepk_fn(k)
+                           for k in self._fuse_sizes}
+        self._admit_fns: Dict[int, Any] = {}
+
+        # host-side slot bookkeeping (dispatcher-thread-owned)
+        self._slots: List[Optional[_DecodeRequest]] = \
+            [None] * self.capacity
+        self._free: collections.deque = collections.deque(
+            range(self.capacity))
+
+        # counters (dispatcher-owned ints; reads copy — GIL-atomic
+        # enough for a metrics scrape, same convention as the
+        # coalescer's hedge counters)
+        self._counters = {"tokens": 0, "steps": 0, "prefills": 0,
+                          "admitted": 0, "evicted": 0,
+                          "fused_dispatches": 0}
+        self._bucket_stats: Dict[str, Dict[int, Any]] = {
+            "hits": {}, "misses": {}, "compile_time_s": {}}
+        self._occupancy = 0
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=int(max_queue))
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._closed = False
+        self._submit_lock = threading.Lock()
+        self._crashed = False
+        # the dispatcher starts LAZILY (first submit), not here:
+        # warmup() runs on the caller thread and rebinds the shared
+        # donated state, so a dispatcher stepping concurrently would
+        # race it into use-after-donate — deferring the start makes
+        # construct -> warmup -> serve safe by construction.  The
+        # condition guards only the handshake FLAGS (the decode state
+        # itself is single-owner by protocol: warmup's thread before
+        # start, the dispatcher after)
+        self._started = False
+        self._warming = False
+        self._start_cond = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._decode_loop, name="zoo-decode-dispatch",
+            daemon=True)
+
+    def _ensure_started(self):
+        with self._start_cond:
+            while self._warming:  # let an in-flight warmup finish
+                self._start_cond.wait()
+            if not self._started:
+                self._started = True
+                self._thread.start()
+
+    # ---- compiled plans -------------------------------------------------
+    def _step_body(self, caches, tok, pos):
+        """ONE slot-array decode step over ALL ``capacity`` slots —
+        the body both step plans trace, so the fused plan is
+        bit-identical to K consecutive single steps by construction.
+        Free slots compute garbage that is never read: their (clamped)
+        position's cache line is rewritten by the step itself before
+        it is attended, and admission overwrites ``[0, bucket)``
+        wholesale.  Shapes depend on (capacity, max_len) only — never
+        occupancy."""
+        params, hyper, max_len = self._params, self._hyper, self.max_len
+        posc = jnp.minimum(pos, max_len - 1)
+        emb = _embed_token(params, tok, posc)
+        logits, caches = _decode_step(params, hyper, caches, emb, posc)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return caches, nxt, jnp.minimum(pos + 1, max_len)
+
+    def _build_step_fn(self):
+        """The persistent single-step plan: (caches, tok, pos) ->
+        (caches', tok', pos')."""
+        # the caches are DONATED: without donation every step copies
+        # the whole (capacity, heads, max_len, d_head) cache array per
+        # layer just to update one position — the in-place update the
+        # scan path gets for free from its loop carry.  Measured ~40%
+        # off the per-step wall on CPU; the loop always rebinds the
+        # returned caches, so the invalidated buffers are never
+        # touched again.  tok/pos are NOT donated: the pipelined loop
+        # still holds the previous step's token vector for its
+        # deferred fetch, and donating would invalidate that buffer
+        # mid-flight (they are (capacity,) ints — the copy is free).
+        return jax.jit(self._step_body, donate_argnums=(0,))
+
+    def _build_stepk_fn(self, k: int):
+        """One fused window plan: ``k`` consecutive decode steps as
+        ONE dispatch (a compiled ``lax.scan`` over
+        :meth:`_step_body`), returning the (k, capacity) token matrix.
+        Per-dispatch overhead — the python call, XLA's per-execution
+        fixed cost, the host fetch — amortizes across k tokens, which
+        is most of the single-step path's deficit against
+        ``TransformerLM.generate``'s monolithic scan.  The dispatcher
+        picks the window so scheduling NEVER changes inside it (see
+        ``_choose_fuse``), so batching stays iteration-level exactly
+        when iteration-level matters."""
+
+        def stepk(caches, tok, pos):
+            def body(carry, _):
+                c, t, p = carry
+                c, t, p = self._step_body(c, t, p)
+                return (c, t, p), t
+
+            (caches, tok, pos), toks = lax.scan(
+                body, (caches, tok, pos), None, length=k)
+            return caches, tok, pos, toks  # toks: (k, capacity)
+
+        return jax.jit(stepk, donate_argnums=(0,))
+
+    def _build_admit_fn(self, s_b: int):
+        """One prompt bucket's admission plan: batched prefill of the
+        (1, s_b) padded prompt, first-token head + argmax, and the
+        K/V insert into slot ``slot`` of the decode state — all one
+        executable, so admitting is a single dispatch."""
+        params, hyper = self._params, self._hyper
+
+        def admit(caches, tok, pos, prompt, length, slot):
+            x, pc = _prefill(params, hyper, prompt, s_b)
+            last = lax.dynamic_index_in_dim(x[0], length - 1,
+                                            keepdims=False)
+            logits0 = _head_logits(params, last[None, :])[0]
+            tok0 = jnp.argmax(logits0, axis=-1).astype(jnp.int32)
+            new_caches = []
+            for (ck, cv), (pk, pv) in zip(caches, pc):
+                ck = lax.dynamic_update_slice(
+                    ck, pk.astype(ck.dtype), (slot, 0, 0, 0))
+                cv = lax.dynamic_update_slice(
+                    cv, pv.astype(cv.dtype), (slot, 0, 0, 0))
+                new_caches.append((ck, cv))
+            tok = lax.dynamic_update_slice(tok, tok0[None], (slot,))
+            pos = lax.dynamic_update_slice(
+                pos, length[None].astype(pos.dtype), (slot,))
+            return new_caches, tok, pos, tok0
+
+        # caches donated for the same in-place-update reason as the
+        # step plan; tok/pos excluded for the same pipeline-aliasing
+        # reason (an admission can run while the previous step's token
+        # vector still awaits its deferred fetch)
+        return jax.jit(admit, donate_argnums=(0,))
+
+    def _admit_fn_for(self, s_b: int):
+        fn = self._admit_fns.get(s_b)
+        if fn is None:
+            fn = self._admit_fns[s_b] = self._build_admit_fn(s_b)
+        return fn
+
+    def warmup(self) -> float:
+        """AOT-compile every prompt bucket's admit plan plus the step
+        plan (deploy pays the compiles, live streams never do).
+        Returns wall seconds.  The warmed admissions land in slot 0 of
+        the REAL state — harmless: the host free-list is untouched, so
+        slot 0 is re-admitted (and its cache overwritten) before any
+        live request reads it.  Must run BEFORE the first submit: the
+        warms rebind the shared donated state on THIS thread, so a
+        live dispatcher would race them into use-after-donate —
+        _start_lock makes a concurrent first submit wait here rather
+        than start one."""
+        t0 = time.perf_counter()
+        with self._start_cond:
+            if self._started:
+                raise RuntimeError(
+                    "DecodeEngine.warmup() must run before the first "
+                    "submit — the dispatcher owns the decode state "
+                    "once it is serving")
+            self._warming = True
+        try:
+            zero = jax.device_put(np.int32(0), self._device)
+            one = jax.device_put(np.int32(1), self._device)
+            for b in self.prompt_buckets:
+                prompt = jax.device_put(np.zeros((1, b), np.int32),
+                                        self._device)
+                fn = self._admit_fn_for(b)
+                tb = time.perf_counter()
+                self._caches, self._tok, self._pos, tok0 = fn(
+                    self._caches, self._tok, self._pos, prompt, one,
+                    zero)
+                jax.device_get(tok0)
+                secs = time.perf_counter() - tb
+                self._bucket_stats["compile_time_s"][b] = \
+                    self._bucket_stats["compile_time_s"].get(b, 0.0) \
+                    + secs
+                self._bucket_stats["misses"][b] = \
+                    self._bucket_stats["misses"].get(b, 0) + 1
+                _slog.info("decode_warmup_bucket", bucket=b,
+                           compile_ms=round(secs * 1e3, 3))
+            self._caches, self._tok, self._pos = self._step_fn(
+                self._caches, self._tok, self._pos)
+            jax.device_get(self._tok)
+            for fn in self._stepk_fns.values():
+                self._caches, self._tok, self._pos, toks = fn(
+                    self._caches, self._tok, self._pos)
+                jax.device_get(toks)
+        finally:
+            with self._start_cond:
+                self._warming = False
+                self._start_cond.notify_all()
+        return time.perf_counter() - t0
+
+    # ---- submission -----------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return (self._closed or self._crashed
+                or (self._started and not self._thread.is_alive()))
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt of {n} tokens exceeds the largest prompt bucket "
+            f"({self.prompt_buckets[-1]})")
+
+    def _validate(self, prompt_ids, max_new_tokens):
+        """Shared request validation — raises ValueError, mutates
+        nothing: (1-D prompt, length, bucket, max_new).  ``generate``
+        pre-validates EVERY row through this before its first submit,
+        so a bad late row cannot orphan earlier rows mid-decode."""
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(
+                f"prompt_ids must be a non-empty 1-D id sequence, got "
+                f"shape {prompt.shape}")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new}")
+        L = int(prompt.shape[0])
+        if L + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({L}) + max_new_tokens ({max_new}) exceeds "
+                f"max_len ({self.max_len})")
+        return prompt, L, self.bucket_for(L), max_new
+
+    def submit(self, prompt_ids, max_new_tokens: int,
+               eos_id: Optional[int] = None, span=None) -> TokenStream:
+        """Queue one prompt for continuous-batching decode; returns its
+        :class:`TokenStream` immediately.  ``prompt_ids``: 1-D int ids
+        (a (1, L) row is accepted too).  ``eos_id`` overrides the
+        engine default; decoding stops at EOS (included in the stream)
+        or after ``max_new_tokens``, whichever is first."""
+        prompt, L, bucket, max_new = self._validate(prompt_ids,
+                                                    max_new_tokens)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :L] = prompt
+        with self._id_lock:
+            self._next_id += 1
+            rid = self._next_id
+        stream = TokenStream(rid)
+        if span is not None:
+            # opened on the caller's thread: covers queue time until
+            # the dispatcher starts this request's prefill
+            span.phase_start("decode_wait")
+        req = _DecodeRequest(padded, L, bucket, max_new,
+                             self.eos_id if eos_id is None else eos_id,
+                             stream, span)
+        with self._submit_lock:
+            if self.closed:
+                raise DecodeEngineClosedError(
+                    "DecodeEngine is closed — no dispatcher is "
+                    "serving this queue")
+            self._q.put(req)
+            # waits out an in-flight warmup — the dispatcher only
+            # begins once the warms are done
+            self._ensure_started()
+        if self._crashed or not self._thread.is_alive():
+            # the dispatcher died between the closed check and the
+            # enqueue — flush anything stranded (same crash-net race
+            # the coalescer's submit covers)
+            self._flush_queue(DecodeEngineClosedError(
+                "DecodeEngine dispatcher died"))
+        return stream
+
+    def generate(self, prompts, max_new_tokens, eos_id=None,
+                 timeout: Optional[float] = None,
+                 span=None) -> List[np.ndarray]:
+        """Blocking convenience over :meth:`submit`: decode a batch of
+        prompts (a (B, L) array, or a list of 1-D ragged rows) and
+        return each row's generated continuation (1-D int32).
+        ``max_new_tokens`` may be per-row (a sequence) or shared.
+        ``span`` rides the request when there is exactly one row (a
+        span is single-owner; batch rows would interleave phases)."""
+        rows = ([np.asarray(prompts[i]) for i in range(len(prompts))]
+                if isinstance(prompts, (list, tuple))
+                else [r for r in np.asarray(prompts)])
+        if np.ndim(max_new_tokens) == 0:
+            max_news = [int(max_new_tokens)] * len(rows)
+        else:
+            max_news = [int(m) for m in max_new_tokens]
+            if len(max_news) != len(rows):
+                raise ValueError(
+                    f"max_new_tokens has {len(max_news)} entries for "
+                    f"{len(rows)} prompts")
+        # all-or-nothing: validate EVERY row before the first submit,
+        # so a bad late row can't leave earlier rows decoding into
+        # abandoned streams (burning slots the caller gave up on)
+        for r, m in zip(rows, max_news):
+            self._validate(r, m)
+        streams = [self.submit(r, m, eos_id=eos_id,
+                               span=span if len(rows) == 1 else None)
+                   for r, m in zip(rows, max_news)]
+        return [s.result(timeout=timeout) for s in streams]
+
+    # ---- stats ----------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Point-in-time decode counters (re-exported per model by
+        ``InferenceModel.serving_stats`` and the Prometheus bridge)."""
+        out = dict(self._counters)
+        out.update(capacity=self.capacity,
+                   slots_active=self._occupancy,
+                   queued=self._q.qsize(),
+                   prompt_buckets=self.prompt_buckets,
+                   prefill_hits=dict(self._bucket_stats["hits"]),
+                   prefill_misses=dict(self._bucket_stats["misses"]),
+                   prefill_compile_time_s=dict(
+                       self._bucket_stats["compile_time_s"]))
+        return out
+
+    # ---- dispatcher -----------------------------------------------------
+    def _flush_queue(self, exc: BaseException):
+        try:
+            while True:
+                r = self._q.get_nowait()
+                if r is not _SHUTDOWN:
+                    if r.span is not None:
+                        r.span.phase_end()
+                    r.stream._finish(exc)
+        except queue.Empty:
+            pass
+
+    def close(self, timeout: float = 5.0):
+        """Stop the dispatcher: active slots finish their streams
+        first (graceful drain), queued-but-unadmitted requests are
+        admitted and served ahead of the shutdown sentinel; anything
+        racing the shutdown fails with DecodeEngineClosedError."""
+        with self._submit_lock:
+            already = self._closed
+            self._closed = True
+            if not already and self._thread.is_alive():
+                self._q.put(_SHUTDOWN)
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        if not self._thread.is_alive():
+            self._flush_queue(DecodeEngineClosedError(
+                "DecodeEngine closed"))
+
+    def _admit_slot(self, req: _DecodeRequest, slot: int):
+        """Admit one queued request into ``slot``: run its bucket's
+        prefill+insert plan, stream the first token, and activate the
+        slot — or finish the request immediately when the first token
+        already ends it (EOS / max_new == 1)."""
+        span = req.span
+        if span is not None:
+            span.phase_start("prefill")
+        fresh = req.bucket not in self._admit_fns
+        stat = ("misses" if (fresh
+                             and req.bucket
+                             not in self._bucket_stats["misses"])
+                else "hits")
+        self._bucket_stats[stat][req.bucket] = \
+            self._bucket_stats[stat].get(req.bucket, 0) + 1
+        fn = self._admit_fn_for(req.bucket)
+        # every host->device hop is explicit (device_put), so the loop
+        # stays clean under zoolint.sanitize() transfer guards — the
+        # scalars included (a bare python int into a jit is an
+        # implicit transfer of its own)
+        prompt_dev = jax.device_put(req.prompt, self._device)
+        length_dev = jax.device_put(np.int32(req.length), self._device)
+        slot_dev = jax.device_put(np.int32(slot), self._device)
+        _profile.note_transfer("h2d")
+        t0 = time.perf_counter()
+        self._caches, self._tok, self._pos, tok0 = fn(
+            self._caches, self._tok, self._pos, prompt_dev,
+            length_dev, slot_dev)
+        tok0 = int(jax.device_get(tok0))
+        _profile.note_transfer("d2h")
+        if fresh:
+            self._bucket_stats["compile_time_s"][req.bucket] = \
+                self._bucket_stats["compile_time_s"].get(
+                    req.bucket, 0.0) + (time.perf_counter() - t0)
+        self._counters["prefills"] += 1
+        self._counters["admitted"] += 1
+        self._counters["tokens"] += 1
+        req.produced = 1
+        req.scheduled = 1
+        req.stream._push(tok0)
+        if span is not None:
+            span.set_label("decode_bucket", req.bucket)
+            span.set_label("decode_slot", slot)
+        done = (req.produced >= req.max_new
+                or (req.eos_id is not None and tok0 == req.eos_id))
+        if done:
+            if span is not None:
+                span.phase_end()
+            self._counters["evicted"] += 1
+            req.stream._finish()
+            self._free.append(slot)
+            return
+        if span is not None:
+            # one phase for the whole shared-step participation —
+            # per-step phases would be ring-buffer noise at 128 steps
+            span.phase_start("decode_step")
+        req.slot = slot
+        self._slots[slot] = req
+        self._occupancy += 1
+
+    def _choose_fuse(self) -> int:
+        """Window size for the next dispatch.  The invariant: a fused
+        window must not CROSS a scheduling event, so admissions and
+        evictions land on exactly the same step indices as pure
+        per-step dispatching — fusion changes overhead, never the
+        schedule.  The window is therefore the minimum
+        remaining-to-schedule over active slots (an EOS-capable
+        request counts as 1 — it can end on any step), clamped to the
+        compiled plan ladder.
+
+        One deliberate exception: with an EMPTY queue, the full
+        ``step_fuse`` window is taken even past a request's end —
+        nobody is waiting for its slot, its surplus tokens are
+        truncated at fan-out, and the only cost is up to K-1 extra
+        slot-steps of garbage against K-fold fewer dispatches on the
+        drain tail.  (A request submitted mid-window waits at most
+        ~K step-times for admission — the same order as the
+        coalescer's gather grace.)
+
+        ``scheduled`` (not ``produced``) drives the remaining check:
+        the pipeline may hold one dispatched-unprocessed window, and
+        planning from ``produced`` would double-schedule it."""
+        if not self._fuse_sizes:
+            return 1
+        if self._q.empty():
+            return self.step_fuse
+        rem = self.step_fuse
+        for req in self._slots:
+            if req is None:
+                continue
+            r = (1 if req.eos_id is not None
+                 else req.max_new - req.scheduled)
+            if r < rem:
+                rem = r
+                if rem <= 1:
+                    return 1
+        for k in self._fuse_sizes:
+            if k <= rem:
+                return k
+        return 1
+
+    def _dispatch_step(self):
+        """Dispatch the next decode window WITHOUT fetching (jax
+        dispatch is asynchronous) and snapshot the slot->request map as
+        of this dispatch — the fetch side fans tokens out against the
+        snapshot, so an eviction or admission that happens while the
+        device computes cannot mis-route a token.  Returns
+        (token vector or (k, capacity) matrix, snapshot, window)."""
+        k = self._choose_fuse()
+        if k > 1:
+            self._caches, self._tok, self._pos, toks = \
+                self._stepk_fns[k](self._caches, self._tok, self._pos)
+            self._counters["fused_dispatches"] += 1
+        else:
+            self._caches, self._tok, self._pos = self._step_fn(
+                self._caches, self._tok, self._pos)
+            toks = self._tok
+        self._counters["steps"] += k
+        for req in self._slots:
+            if req is not None:
+                req.scheduled += k
+        return toks, list(self._slots), k
+
+    def _process_step(self, pending):
+        """Fetch a dispatched window's token vector ((capacity,) for a
+        single step, (K, capacity) fused) and fan it out to the slots
+        that were live AT DISPATCH TIME, evicting finished ones.  A
+        request that finished in an EARLIER window's processing (the
+        pipeline dispatches window k+1 before window k is processed,
+        so its snapshot can still name it) is skipped — its stream is
+        closed and the slot's extra computed tokens are garbage by
+        construction, as are any tokens past a request's max_new/EOS
+        inside a fused window."""
+        tok_dev, snapshot, k = pending
+        toks = jax.device_get(tok_dev)
+        _profile.note_transfer("d2h")
+        if k == 1:
+            toks = toks.reshape(1, -1)
+        for slot, req in enumerate(snapshot):
+            if req is None or req.stream.done:
+                continue
+            for j in range(k):
+                tok = int(toks[j, slot])
+                req.produced += 1
+                self._counters["tokens"] += 1
+                req.stream._push(tok)
+                if (req.produced >= req.max_new
+                        or (req.eos_id is not None
+                            and tok == req.eos_id)):
+                    if req.span is not None:
+                        req.span.phase_end()
+                    self._counters["evicted"] += 1
+                    self._occupancy -= 1
+                    req.stream._finish()
+                    self._slots[slot] = None
+                    self._free.append(slot)
+                    break
+
+    def _decode_loop(self):
+        try:
+            self._loop_inner()
+        except BaseException as e:  # crash net: never strand a caller
+            # _crashed (this is its ONLY writer; the closed property
+            # folds it in) flips BEFORE the lock barrier: a submit
+            # already inside its critical section finishes the enqueue
+            # and its own post-put check flushes, one entering after
+            # sees closed and raises.  The acquire is a BARRIER, not a
+            # guard — bounded because a submitter blocked on a full
+            # queue holds the lock until our flush below frees a slot,
+            # so we must not wait on it forever.
+            self._crashed = True
+            got = self._submit_lock.acquire(timeout=1.0)
+            if got:
+                self._submit_lock.release()
+            self._flush_queue(e)
+            for slot, req in enumerate(self._slots):
+                if req is not None:
+                    if req.span is not None:
+                        req.span.phase_end()
+                    req.stream._finish(e)
+                    self._slots[slot] = None
+            self._occupancy = 0
+            raise
+
+    def _loop_inner(self):
+        # one-deep step pipeline: step k+1 is DISPATCHED before step
+        # k's tokens are fetched, so the host side (token fan-out,
+        # eviction, stream wake-ups, the next admission) overlaps the
+        # device compute instead of serializing with it — the
+        # serving-side analog of the coalescer's one-deep dispatch
+        # pipeline.  Cost: an eviction is observed one step late, so a
+        # freed slot re-admits one step later (bounded occupancy
+        # slack, never a correctness issue — see _process_step).
+        pending = None
+        shutdown = False
+        while True:
+            # 1. admit queued requests into free slots — between
+            # steps, which is what makes the batching iteration-level
+            while self._free and not shutdown:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _SHUTDOWN:
+                    shutdown = True
+                    break
+                self._admit_slot(nxt, self._free.popleft())
+            # 2. dispatch the next step, then fan out the previous one
+            nxt_pending = (self._dispatch_step() if self._occupancy
+                           else None)
+            if pending is not None:
+                self._process_step(pending)
+            pending = nxt_pending
+            # 3. idle: wait for work (or drain out on shutdown)
+            if pending is None and not self._occupancy:
+                if shutdown:
+                    return
+                try:
+                    nxt = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if nxt is _SHUTDOWN:
+                    shutdown = True
+                    continue
+                self._admit_slot(nxt, self._free.popleft())

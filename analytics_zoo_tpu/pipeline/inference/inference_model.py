@@ -22,6 +22,7 @@ import jax
 
 from ...observability import profile as _profile
 from ...observability import trace as _trace
+from .decode import DecodeEngine
 from .serving import (BucketedExecutableCache, CoalescerClosedError,
                       ReplicaSet, RequestCoalescer, _rows)
 
@@ -68,7 +69,11 @@ class InferenceModel:
                  replicas=1,
                  hedging: bool = False,
                  hedge_quantile: float = 0.99,
-                 hedge_min_ms: float = 0.5):
+                 hedge_min_ms: float = 0.5,
+                 decode_capacity: Optional[int] = None,
+                 decode_max_len: Optional[int] = None,
+                 decode_prompt_buckets: Optional[Sequence[int]] = None,
+                 decode_eos_id: Optional[int] = None):
         """``supported_concurrent_num`` bounds concurrent device work
         (reference semantics; PER REPLICA when replicated — the
         effective bound scales with the replica count).  The serving
@@ -101,6 +106,16 @@ class InferenceModel:
           healthy replica and the first result wins — bit-exact either
           way (same serialized executable on every replica).  No-ops
           with fewer than 2 eligible replicas.
+        * ``decode_capacity`` — attach a continuous-batching
+          :class:`~.decode.DecodeEngine` with that many slots when a
+          language model (a net with ``generate`` + a transformer
+          ``hyper``) is loaded, enabling :meth:`generate` /
+          :meth:`generate_stream` with iteration-level scheduling.
+          ``decode_max_len`` / ``decode_prompt_buckets`` /
+          ``decode_eos_id`` configure it (see the engine's docstring).
+          The engine is warmed at load — every (bucket, capacity)
+          plan compiles before the handle serves, never under a live
+          stream.
         """
         self.concurrent_num = int(supported_concurrent_num)
         self._semaphore = threading.Semaphore(self.concurrent_num)
@@ -119,6 +134,12 @@ class InferenceModel:
         self._hedging = bool(hedging)
         self._hedge_quantile = float(hedge_quantile)
         self._hedge_min_ms = float(hedge_min_ms)
+        self._decode_capacity = (None if decode_capacity is None
+                                 else int(decode_capacity))
+        self._decode_max_len = decode_max_len
+        self._decode_prompt_buckets = decode_prompt_buckets
+        self._decode_eos_id = decode_eos_id
+        self._decode_engine: Optional[DecodeEngine] = None
         self._cache: Optional[BucketedExecutableCache] = None
         self._coalescer: Optional[RequestCoalescer] = None
         # (predict_fn, cache, coalescer) published as ONE tuple: a
@@ -156,9 +177,49 @@ class InferenceModel:
         if quantize:
             net = net.quantize()
         trainer = net.ensure_inference_ready()
+        # build + warm the decode engine BEFORE publishing the predict
+        # plane: a reload whose engine build fails (non-LM path, warmup
+        # crash) must leave the handle fully on the OLD version — a
+        # half-swapped handle (new predict, stale generate) is the one
+        # state no caller can reason about
+        engine = self._build_decode_engine(net, trainer)
         self._attach(net.to_graph(), trainer.state.params,
                      trainer.state.model_state)
+        if self._decode_capacity is not None:
+            old, self._decode_engine = self._decode_engine, engine
+            if old is not None:
+                # close AFTER the swap (the reload discipline of
+                # ``_install``): the old engine's active streams drain
+                # on the old plans while new submits hit the new ones
+                old.close()
         return self
+
+    def _build_decode_engine(self, net, trainer):
+        """Validate, build, and warm the continuous-batching decode
+        engine when ``decode_capacity`` is configured and the loaded
+        net is a generation-capable LM.  Pure — publishes nothing;
+        any failure here leaves the handle untouched."""
+        if self._decode_capacity is None:
+            return None
+        hyper = getattr(net, "hyper", None)
+        if (not callable(getattr(net, "generate", None))
+                or not isinstance(hyper, dict)
+                or "n_layers" not in hyper):
+            raise ValueError(
+                "decode_capacity needs a generation-capable language "
+                f"model (TransformerLM-like), got {type(net).__name__}")
+        if getattr(self, "_quantize_flag", False):
+            raise ValueError(
+                "decode_capacity is not supported for quantized "
+                "handles (the decode math reads float params by name)")
+        engine = DecodeEngine(
+            trainer.state.params, hyper,
+            capacity=self._decode_capacity,
+            max_len=self._decode_max_len,
+            prompt_buckets=self._decode_prompt_buckets,
+            eos_id=self._decode_eos_id)
+        engine.warmup()
+        return engine
 
     def load_tf(self, path: Optional[str] = None, net=None,
                 input_names=None, output_names=None):
@@ -384,12 +445,58 @@ class InferenceModel:
             out["coalescer_pending"] = coalescer.pending
             if coalescer.hedging:
                 out["hedges"] = coalescer.hedge_stats()
+        engine = self._decode_engine
+        if engine is not None:
+            out["decode"] = engine.stats()
         return out
 
+    # ---- continuous-batching generation ----
+    @property
+    def decode_engine(self) -> Optional[DecodeEngine]:
+        """The attached continuous-batching engine (None unless the
+        handle was built with ``decode_capacity`` and loaded an LM)."""
+        return self._decode_engine
+
+    def _require_engine(self) -> DecodeEngine:
+        engine = self._decode_engine
+        if engine is None:
+            raise RuntimeError(
+                "no decode engine: construct the InferenceModel with "
+                "decode_capacity= and load a generation-capable LM")
+        return engine
+
+    def generate(self, prompt_ids, max_new_tokens,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = None):
+        """Continuous-batching greedy decode: each prompt (a (B, L)
+        array or a list of ragged 1-D id rows) is bucketed, prefilled,
+        and slot-scheduled per decode step alongside every other live
+        request — a short request never pays a long neighbor's latency.
+        Returns each row's generated continuation (list of 1-D int32
+        arrays; EOS included when hit).  ``max_new_tokens`` may be
+        per-row.  Token-identical to ``TransformerLM.generate``'s
+        compiled scan for the same prompt."""
+        return self._require_engine().generate(
+            prompt_ids, max_new_tokens, eos_id=eos_id, timeout=timeout,
+            span=_trace.current_span())
+
+    def generate_stream(self, prompt_ids, max_new_tokens: int,
+                        eos_id: Optional[int] = None):
+        """Streaming single-prompt decode: returns a
+        :class:`~.decode.TokenStream` immediately — iterate it for
+        per-token delivery, or ``.result()`` for the full
+        continuation."""
+        span = _trace.current_span()
+        return self._require_engine().submit(
+            prompt_ids, max_new_tokens, eos_id=eos_id, span=span)
+
     def close(self):
-        """Stop the coalescer dispatcher thread (no-op without one)."""
+        """Stop the coalescer and decode dispatcher threads (no-op
+        without them)."""
         if self._coalescer is not None:
             self._coalescer.close()
+        if self._decode_engine is not None:
+            self._decode_engine.close()
 
     def reload(self, model_path: str, weight_path: Optional[str] = None,
                quantize: Optional[bool] = None):

@@ -76,6 +76,17 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
         "zoo_coalescer_dispatches_total": [],
         "zoo_coalesced_requests_total": [],
     }
+    # continuous-batching decode: per-token/step counters + the live
+    # slot-occupancy gauge (capacity alongside, so occupancy reads as
+    # a fraction without a dashboard join)
+    decode_counters: Dict[str, List] = {
+        "zoo_decode_tokens_total": [],
+        "zoo_decode_steps_total": [],
+    }
+    decode_gauges: Dict[str, List] = {
+        "zoo_decode_slot_occupancy": [],
+        "zoo_decode_slot_capacity": [],
+    }
     # ONE summary family for every (model, version): emitting a Family
     # per version would render duplicate # TYPE blocks for the same
     # name, which real Prometheus parsers reject outright
@@ -149,6 +160,16 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
         if "coalescer_pending" in serving:
             model_gauges["zoo_coalescer_pending"].append(
                 (ml, serving["coalescer_pending"]))
+        dec = serving.get("decode")
+        if dec:
+            decode_counters["zoo_decode_tokens_total"].append(
+                (ml, dec.get("tokens", 0)))
+            decode_counters["zoo_decode_steps_total"].append(
+                (ml, dec.get("steps", 0)))
+            decode_gauges["zoo_decode_slot_occupancy"].append(
+                (ml, dec.get("slots_active", 0)))
+            decode_gauges["zoo_decode_slot_capacity"].append(
+                (ml, dec.get("capacity", 0)))
         # device-parallel serving: per-replica dispatch counters (and
         # their per-bucket breakdown — the bucket metrics' replica
         # label) plus the health gauge
@@ -220,15 +241,24 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
         "zoo_hedge_total":
             "hedged dispatch outcomes (fired/primary_won/hedge_won/"
             "skipped_no_replica)",
+        "zoo_decode_tokens_total":
+            "tokens generated by the continuous-batching decode "
+            "engine (prefill first tokens included)",
+        "zoo_decode_steps_total":
+            "slot-array decode steps dispatched",
+        "zoo_decode_slot_occupancy":
+            "decode slots currently holding a live sequence",
+        "zoo_decode_slot_capacity":
+            "decode slots in the persistent step executable",
     }
     out: List[Family] = []
     gauge_groups = (model_gauges, version_gauges, replica_gauges,
-                    class_gauges,
+                    class_gauges, decode_gauges,
                     {k: v for k, v in admission.items()
                      if not k.endswith("_total")})
     counter_groups = (model_counters, version_counters,
                       bucket_counters, coalescer_counters,
-                      replica_counters, class_counters,
+                      replica_counters, class_counters, decode_counters,
                       {k: v for k, v in admission.items()
                        if k.endswith("_total")})
     for groups, mtype in ((gauge_groups, "gauge"),

@@ -370,10 +370,24 @@ class ModelRegistry:
         ``priority_class`` tags the request for the admission
         controller's shedding order and weighted fair share (the
         registry's ``priority_classes`` config names the classes)."""
+        return self._serve_ex(
+            name, "predict", lambda model: model.predict(inputs),
+            deadline_ms=deadline_ms, trace_id=trace_id,
+            priority_class=priority_class)
+
+    def _serve_ex(self, name: str, op: str, call,
+                  deadline_ms: Optional[float] = None,
+                  trace_id: Optional[str] = None,
+                  priority_class: Optional[str] = None
+                  ) -> Tuple[Any, Dict[str, Any]]:
+        """The shared serve envelope — span + admission + canary
+        routing + per-version counters/latency around ONE data-plane
+        ``call(model)`` — used by both :meth:`predict_ex` and
+        :meth:`generate_ex` so the two paths can never drift in
+        admission or span semantics."""
         entry = self._entry(name)
         tracer = self.tracer
-        span = (tracer.start_span("predict", trace_id=trace_id,
-                                  model=name)
+        span = (tracer.start_span(op, trace_id=trace_id, model=name)
                 if tracer is not None else None)
         try:
             with _trace.activate(span), \
@@ -387,7 +401,7 @@ class ModelRegistry:
                         span.set_label("canary", True)
                 t0 = time.perf_counter()
                 try:
-                    out = dep.model.predict(inputs)
+                    out = call(dep.model)
                 except BaseException:
                     dep.counters.inc("errors")
                     raise
@@ -405,6 +419,40 @@ class ModelRegistry:
         if span is not None:
             info["request_id"] = span.trace_id
         return out, info
+
+    def generate(self, name: str, prompt_ids, max_new_tokens,
+                 deadline_ms: Optional[float] = None,
+                 priority_class: Optional[str] = None,
+                 eos_id: Optional[int] = None):
+        out, _ = self.generate_ex(name, prompt_ids, max_new_tokens,
+                                  deadline_ms=deadline_ms,
+                                  priority_class=priority_class,
+                                  eos_id=eos_id)
+        return out
+
+    def generate_ex(self, name: str, prompt_ids, max_new_tokens,
+                    deadline_ms: Optional[float] = None,
+                    trace_id: Optional[str] = None,
+                    priority_class: Optional[str] = None,
+                    eos_id: Optional[int] = None
+                    ) -> Tuple[Any, Dict[str, Any]]:
+        """The continuous-batching generate path: same admission /
+        routing / counters / span discipline as :meth:`predict_ex`,
+        but the data plane is the model's ``DecodeEngine`` — the
+        request joins the live slot array at the next decode step and
+        streams until EOS or ``max_new_tokens``.  Returns (list of
+        per-row continuation arrays, routing info).  The admission
+        slot is held for the whole decode: a decoding request IS
+        in-flight work, and releasing early would let max_concurrency
+        overcommit the engine's queue.  Requires the deployment to
+        have been built with ``decode_capacity`` (raises
+        RuntimeError otherwise)."""
+        return self._serve_ex(
+            name, "generate",
+            lambda model: model.generate(prompt_ids, max_new_tokens,
+                                         eos_id=eos_id),
+            deadline_ms=deadline_ms, trace_id=trace_id,
+            priority_class=priority_class)
 
     def _route(self, entry: _Entry) -> Tuple[_Deployment, bool]:
         """Pick the serving version.  Canary routing uses an error

@@ -33,7 +33,12 @@ step — it primes replicas inline, so a stray sync or print there
 stalls scale-ups), ``_resolve_hedged`` (the hedge dispatch/first-wins
 resolve), and ``maybe_reprobe`` (the health-probe driver) — all three
 run on or block serving threads even though none is reachable from
-``predict`` by name alone.
+``predict`` by name alone.  The continuous-batching decode engine adds
+``_loop_inner`` (the per-step dispatcher loop — a stray sync there
+stalls EVERY live stream, not one request) and ``_admit_slot`` (the
+prefill + slot-insert path each arriving sequence rides); ``submit``
+was already an entry, so the TokenStream producer side is covered by
+the existing BFS.
 """
 
 from __future__ import annotations
@@ -46,7 +51,8 @@ from .findings import Finding
 
 DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        "dispatch_padded", "dispatch", "pack",
-                       "tick", "_resolve_hedged", "maybe_reprobe")
+                       "tick", "_resolve_hedged", "maybe_reprobe",
+                       "_loop_inner", "_admit_slot")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

@@ -12,6 +12,11 @@ POST /predict {"instances": [[...], ...],              -> {"predictions": [...],
                "model": "default",       # optional        "model": ..., "version": ...,
                "deadline_ms": 250,       # optional        "request_id": ...}
                "class": "interactive"}   # optional priority class
+POST /generate {"prompt": [ids] | [[ids], ...],        -> {"tokens": [[...], ...],
+                "max_new_tokens": 8,                       "model": ..., "version": ...,
+                "model": "lm"}           # optional        "request_id": ...}
+               # continuous-batching decode: requests share the slot
+               # array per decode step (see docs/serving.md)
 POST /deploy  {"model": "default", "seed": 1,          -> {"model": ..., "version": v}
                "hidden": 16, "canary_fraction": 0.2}   # canary optional
 POST /promote {"model": "default"}                     -> {"version": v}
@@ -49,6 +54,9 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 DEFAULT_MODEL = "default"
+LM_MODEL = "lm"
+LM_VOCAB = 32
+LM_SEQ = 24
 N_FEATURES = 8
 N_CLASSES = 3
 TRACE_RING = 512
@@ -70,6 +78,19 @@ def build_net(hidden: int = 16, seed: int = 0):
     net.trainer = Trainer(net.to_graph(), None, optimizers.get("sgd"),
                           seed=seed)
     return net
+
+
+def build_lm():
+    """A miniature TransformerLM for the continuous-batching generate
+    path (stand-in for a real chat model).  Random-initialized —
+    the sample demonstrates the SERVING mechanics (slot admission,
+    streaming, per-token metrics), not language quality."""
+    from analytics_zoo_tpu.models import TransformerLM
+
+    lm = TransformerLM(vocab_size=LM_VOCAB, seq_len=LM_SEQ, n_layers=1,
+                       d_model=16, n_heads=2)
+    lm.ensure_inference_ready()
+    return lm
 
 
 def build_registry():
@@ -106,6 +127,13 @@ def build_registry():
     metrics.register_collector(prof.families)
     registry.deploy(DEFAULT_MODEL, build_net(),
                     warmup_shapes=(N_FEATURES,))
+    # the LM behind /generate: a continuous-batching DecodeEngine
+    # (decode_capacity slots) — no predict-ladder warmup (that path is
+    # unused for an LM; the engine warms its own admit/step plans at
+    # load, so the first stream never compiles).  Single-device: the
+    # decode state is stateful, so replicas stay at 1.
+    registry.deploy(LM_MODEL, build_lm(), decode_capacity=2,
+                    decode_prompt_buckets=(8,), replicas=1)
     return registry, {"tracer": tracer, "metrics": metrics,
                       "profile": prof}
 
@@ -201,6 +229,23 @@ def make_handler(registry, obs=None):
                     self._reply(200, {
                         "predictions": np.asarray(preds).tolist(), **info},
                         headers={"X-Request-Id": rid})
+                elif self.path == "/generate":
+                    from analytics_zoo_tpu.observability.trace import \
+                        new_trace_id
+                    rid = (self.headers.get("X-Request-Id")
+                           or new_trace_id())
+                    prompt = np.asarray(payload["prompt"], dtype=np.int32)
+                    if prompt.ndim == 1:
+                        prompt = prompt[None, :]
+                    toks, info = registry.generate_ex(
+                        payload.get("model", LM_MODEL), prompt,
+                        int(payload.get("max_new_tokens", 8)),
+                        deadline_ms=payload.get("deadline_ms"),
+                        trace_id=rid,
+                        priority_class=payload.get("class"))
+                    self._reply(200, {
+                        "tokens": [np.asarray(t).tolist() for t in toks],
+                        **info}, headers={"X-Request-Id": rid})
                 elif self.path == "/deploy":
                     name = payload.get("model", DEFAULT_MODEL)
                     net = build_net(hidden=int(payload.get("hidden", 16)),
@@ -361,10 +406,30 @@ def self_test(port: int):
           f"{best['phase_total_ms']:.2f} ms "
           f"(coverage {best['coverage']:.1%}) OK")
 
+    # ---- continuous-batching generate: the LM model decodes through
+    # the slot-array engine — deterministic (greedy), so two identical
+    # requests must stream identical tokens, and the request must
+    # carry the decode span phases (prefill -> decode_step)
+    lm_prompt = [[1, 2, 3, 4, 5]]
+    g1, gh = call("/generate", {"prompt": lm_prompt,
+                                "max_new_tokens": 6},
+                  return_headers=True)
+    g2 = call("/generate", {"prompt": lm_prompt, "max_new_tokens": 6})
+    assert g1["model"] == LM_MODEL and g1["version"] >= 1
+    assert len(g1["tokens"]) == 1 and len(g1["tokens"][0]) == 6, g1
+    assert g1["tokens"] == g2["tokens"], (g1, g2)
+    gtr = call(f"/traces?id={gh['X-Request-Id']}")
+    gphases = {p["name"] for p in gtr["phases"]}
+    assert {"prefill", "decode_step"} <= gphases, gphases
+    print(f"generate check: {LM_MODEL} streamed "
+          f"{len(g1['tokens'][0])} tokens deterministically, decode "
+          "span phases present OK")
+
     # ---- Prometheus exposition: scrape + round-trip the parser; the
     # per-model/version/bucket labels must survive.  A class-tagged
     # request FIRST, so the per-class families carry a non-default
-    # series in the scrape.
+    # series in the scrape (same for the /generate calls above — the
+    # decode families must carry live series, not zeros).
     call("/predict", {"instances": payloads[0], "class": "batch"})
     with urlopen(f"http://127.0.0.1:{port}/metrics?format=prometheus",
                  timeout=30) as resp:
@@ -393,6 +458,25 @@ def self_test(port: int):
                 if k[0] == "zoo_class_admitted_total"]
     assert any(dict(k[1]).get("class") == "batch" for k in admitted), \
         admitted
+    # the continuous-batching decode families must carry LIVE series
+    # tagged with the LM model (the /generate calls above ran before
+    # this scrape — the PR 6 scrape-order lesson): tokens/steps moved,
+    # capacity reads the deployed slot count, occupancy is back to 0
+    # on the now-idle engine
+    for fam in ("zoo_decode_tokens_total", "zoo_decode_steps_total",
+                "zoo_decode_slot_occupancy", "zoo_decode_slot_capacity"):
+        assert fam in names, f"{fam} missing from exposition"
+    dec = {k[0]: v for k, v in parsed["samples"].items()
+           if k[0].startswith("zoo_decode_")
+           and dict(k[1]).get("model") == LM_MODEL}
+    assert dec.get("zoo_decode_tokens_total", 0) >= 12, dec
+    assert dec.get("zoo_decode_steps_total", 0) > 0, dec
+    assert dec.get("zoo_decode_slot_capacity") == 2, dec
+    assert dec.get("zoo_decode_slot_occupancy") == 0, dec
+    assert parsed["types"]["zoo_decode_tokens_total"] == "counter"
+    assert parsed["types"]["zoo_decode_slot_occupancy"] == "gauge"
+    print("decode scrape check: live zoo_decode_* series for "
+          f"model={LM_MODEL} OK")
     assert parsed["types"]["zoo_model_requests_total"] == "counter"
     print(f"prometheus scrape OK ({len(parsed['samples'])} samples, "
           f"{len(names)} series names)")

@@ -1573,6 +1573,200 @@ def _bench_replicas(mlp, params, d_in, max_batch, max_wait_ms,
     return results, ok
 
 
+def _bench_decode(selfcheck: bool, quick: bool = False):
+    """Continuous batching vs naive batch-of-requests decode (ISSUE 7).
+
+    Mixed prompt/output-length traffic through the slot-array
+    ``DecodeEngine`` (iteration-level admission/eviction) against the
+    strawman it replaces: groups of ``capacity`` requests decoded by
+    ``TransformerLM.generate``'s compiled scan to the LONGEST member's
+    output length — every rider pays the group max, so useful-token
+    throughput craters on mixed lengths.  Output lengths cycle a
+    HEAVY-TAILED mix (mostly short, one long per cycle — the
+    chat-traffic shape where the group-max tax is worst); tokens/s
+    counts REQUESTED tokens only on both sides.
+
+    Per the perf-flake policy the two sides run interleaved
+    (naive, engine) back-to-back per attempt within ONE process, and
+    the gate (engine >= 1.5x naive) takes the best attempt, retried
+    bounded.  Correctness gates are absolute: per-slot streamed
+    outputs bit-exact vs the scan path for every request, exactly one
+    prefill compile per (bucket, capacity), and a sanitize-clean
+    warmed engine loop.
+    """
+    import numpy as np
+
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.pipeline.inference.decode import DecodeEngine
+    from analytics_zoo_tpu.tools.zoolint import sanitize
+
+    # n_requests >> capacity on purpose: the win comes from slots
+    # re-filling as short members leave, so the one unavoidable
+    # low-occupancy window (the final burst drain, bounded by one
+    # max-length decode) must amortize over enough admissions — at
+    # n = capacity the measurement is all tail and shows the burst
+    # edge case, not the steady mixed stream the engine serves in
+    # production.  The model is sized so per-step COMPUTE dominates
+    # the python dispatcher (a toy step measures loop overhead, not
+    # the scheduling mechanism the gate is about), and max_len equals
+    # bucket + max(out) exactly — the slot cache must not attend over
+    # MORE positions than the scan comparator's (both pay their cache
+    # length every step).  quick is the same shape with fewer
+    # requests/attempts.
+    vocab, d_model, n_heads, n_layers = 128, 128, 4, 2
+    max_len, bucket, capacity = 160, 32, 8
+    out_lens = (8, 8, 8, 8, 128)
+    p_lo, p_hi = 4, 32
+    # n divisible by capacity: a ragged trailing group would compile
+    # (and measure) its own scan plan instead of the shared one
+    if quick:
+        n_requests, attempts = 64, 2
+    else:
+        n_requests, attempts = 160, 3
+    lm = TransformerLM(vocab_size=vocab, seq_len=max_len,
+                       n_layers=n_layers, d_model=d_model,
+                       n_heads=n_heads)
+    trainer = lm.ensure_inference_ready()
+    rng = np.random.default_rng(0)
+    reqs = []
+    for i in range(n_requests):
+        L = int(rng.integers(p_lo, p_hi + 1))
+        reqs.append((rng.integers(0, vocab, L),
+                     out_lens[i % len(out_lens)]))
+    useful = sum(mn for _, mn in reqs)
+
+    engine = DecodeEngine(trainer.state.params, lm.hyper,
+                          capacity=capacity, max_len=max_len,
+                          prompt_buckets=(bucket,))
+    engine.warmup()
+
+    def run_engine():
+        t0 = time.perf_counter()
+        outs = engine.generate([p for p, _ in reqs],
+                               [mn for _, mn in reqs], timeout=600)
+        return useful / (time.perf_counter() - t0), outs
+
+    def run_naive():
+        t0 = time.perf_counter()
+        outs = []
+        for g in range(0, n_requests, capacity):
+            grp = reqs[g:g + capacity]
+            mx = max(mn for _, mn in grp)
+            lens = np.array([len(p) for p, _ in grp])
+            padded = np.zeros((len(grp), bucket), np.int32)
+            for j, (p, _) in enumerate(grp):
+                padded[j, :len(p)] = p
+            full = lm.generate(padded, max_new_tokens=mx,
+                               temperature=0.0, prompt_lengths=lens)
+            for j, (p, mn) in enumerate(grp):
+                outs.append(full[j, lens[j]:lens[j] + mn])
+        return useful / (time.perf_counter() - t0), outs
+
+    # warm BOTH plans before any timed attempt (the scan plan cache
+    # and the engine's admit/step executables), and keep the outputs —
+    # they are the bit-exactness gate's two sides
+    _, naive_outs = run_naive()
+    _, engine_outs = run_engine()
+    bitexact = all(np.array_equal(a, b)
+                   for a, b in zip(engine_outs, naive_outs))
+
+    pairs = []
+    for _ in range(attempts):
+        n_tps, _ = run_naive()
+        e_tps, _ = run_engine()
+        pairs.append((n_tps, e_tps))
+    n_tps, e_tps = max(pairs, key=lambda p: p[1] / p[0])
+    ratio = round(e_tps / n_tps, 2)
+    extra = 0
+    while selfcheck and ratio < 1.5 and extra < 4:
+        # the mechanism stops charging riders the group max — the
+        # 2-core scheduler can still eat any single attempt
+        extra += 1
+        n2, _ = run_naive()
+        e2, _ = run_engine()
+        r2 = round(e2 / n2, 2)
+        _log(f"decode gate retry {extra}: ratio {r2:.2f}x")
+        if r2 > ratio:
+            n_tps, e_tps, ratio = n2, e2, r2
+
+    stats = engine.stats()
+    one_compile = all(v == 1 for v in stats["prefill_misses"].values())
+    san = {"clean": False, "error": None}
+    try:
+        with sanitize(max_compiles=0):
+            engine.generate([p for p, _ in reqs[:capacity]],
+                            [min(mn, 8) for _, mn in reqs[:capacity]],
+                            timeout=600)
+        san["clean"] = True
+    except Exception as e:  # noqa: BLE001 — verdict recorded + gated
+        san["error"] = f"{type(e).__name__}: {e}"
+    engine.close()
+
+    results = {
+        "config": {"d_model": d_model, "n_layers": n_layers,
+                   "n_heads": n_heads, "max_len": max_len,
+                   "prompt_bucket": bucket, "capacity": capacity,
+                   "out_lens": list(out_lens),
+                   "n_requests": n_requests, "useful_tokens": useful},
+        "engine_tokens_per_sec": round(e_tps, 1),
+        "naive_tokens_per_sec": round(n_tps, 1),
+        "throughput_ratio": ratio,
+        "bit_exact": bitexact,
+        "one_compile_per_bucket": one_compile,
+        "prefill_misses": stats["prefill_misses"],
+        "steps": stats["steps"], "tokens": stats["tokens"],
+        "sanitize": san,
+        "gate_retries": extra,
+    }
+    ok = True
+    gate = "PASS" if ratio >= 1.5 else "FAIL"
+    _log(f"decode continuous batching: engine {e_tps:,.0f} tok/s  "
+         f"naive {n_tps:,.0f} tok/s  (useful tokens, mixed outputs "
+         f"{out_lens})")
+    print(f"DECODE_TOKENS_GATE ratio={ratio:.2f}x "
+          f"engine={e_tps:.0f} naive={n_tps:.0f} (>=1.5x {gate})",
+          flush=True)
+    if selfcheck:
+        if ratio < 1.5:
+            _log(f"decode selfcheck FAIL: tokens/s ratio {ratio}x < "
+                 "1.5x vs naive batch-of-requests decode")
+            ok = False
+        if not bitexact:
+            _log("decode selfcheck FAIL: engine stream diverged from "
+                 "the scan decode path")
+            ok = False
+        if not one_compile:
+            _log(f"decode selfcheck FAIL: prefill compiled a bucket "
+                 f"more than once: {stats['prefill_misses']}")
+            ok = False
+        if not san["clean"]:
+            _log(f"decode selfcheck FAIL: sanitize violation in the "
+                 f"warmed decode loop: {san['error']}")
+            ok = False
+        if ok:
+            _log(f"decode selfcheck: ratio {ratio}x, bit-exact, one "
+                 "compile per (bucket, capacity), sanitize clean")
+    return results, ok
+
+
+def decode_bench(quick: bool = False, selfcheck: bool = False,
+                 out_path: str = None) -> int:
+    """Standalone continuous-batching section (``bench.py decode``) —
+    the smoke script runs it ``--quick --selfcheck`` under 2 forced
+    host devices."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    results, ok = _bench_decode(selfcheck, quick=quick)
+    print("BENCH_DECODE " + json.dumps(results), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+    if selfcheck:
+        print("DECODE_SELFCHECK_" + ("OK" if ok else "FAIL"),
+              flush=True)
+        return 0 if ok else 1
+    return 0
+
+
 def serving_bench(n_requests: int = 400, d_in: int = 64, d_hidden: int = 64,
                   n_layers: int = 192, max_batch: int = 32,
                   concurrencies=(1, 8, 32), max_wait_ms: float = 20.0,
@@ -1904,6 +2098,11 @@ def serving_bench(n_requests: int = 400, d_in: int = 64, d_hidden: int = 64,
         mlp, params, d_in, max_batch, max_wait_ms, selfcheck)
     results["registry"] = reg_results
     if selfcheck and not reg_ok:
+        ok = False
+    # ---- continuous batching: slot-array decode engine (ISSUE 7) ----
+    dec_results, dec_ok = _bench_decode(selfcheck)
+    results["decode"] = dec_results
+    if selfcheck and not dec_ok:
         ok = False
     # emitted AFTER the selfcheck retries so the archived numbers match
     # the gate verdict
@@ -2555,6 +2754,21 @@ if __name__ == "__main__":
             out = sys.argv[sys.argv.index("--out") + 1]
         sys.exit(serving_bench(selfcheck="--selfcheck" in sys.argv,
                                out_path=out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "decode":
+        # 2 forced host devices match the smoke script's environment
+        # (the engine itself is single-device; this pins coexistence
+        # with a multi-device host)
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+        _out = None
+        if "--out" in sys.argv:
+            _out = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(decode_bench(quick="--quick" in sys.argv,
+                              selfcheck="--selfcheck" in sys.argv,
+                              out_path=_out))
     elif len(sys.argv) > 1 and sys.argv[1] == "loadtest":
         # the elastic gates need >1 device: force 2 virtual host
         # devices BEFORE jax initializes (no-op when the caller — the

@@ -58,4 +58,23 @@ grep -q "LOADTEST_SELFCHECK_OK" <<<"$lt" || {
     echo "smoke FAIL: loadtest selfcheck gates failed" >&2
     exit 1
 }
+
+# Continuous-batching gate: the slot-array decode engine's --quick
+# selfcheck under the same 2 forced host devices — useful-token
+# throughput >= 1.5x naive batch-of-requests scan decode on a
+# heavy-tailed mixed-length workload, per-slot streams bit-exact vs
+# the scan path, exactly one compile per (bucket, capacity) plan, and
+# a sanitize-clean warmed decode loop.
+dc=$(timeout -k 10 600 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python bench.py decode --quick --selfcheck)
+printf '%s\n' "$dc"
+grep -Eq "DECODE_TOKENS_GATE ratio=[0-9.]+x .* PASS" <<<"$dc" || {
+    echo "smoke FAIL: decode tokens/s gate missing or failed" >&2
+    exit 1
+}
+grep -q "DECODE_SELFCHECK_OK" <<<"$dc" || {
+    echo "smoke FAIL: decode selfcheck gates failed" >&2
+    exit 1
+}
 echo "serving smoke OK"

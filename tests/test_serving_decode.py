@@ -1,0 +1,430 @@
+"""Continuous-batching decode engine (ISSUE 7): slot-array stepping,
+iteration-level scheduling, and the serving wiring.
+
+The pinned contracts:
+* a slot stepped one token at a time is BIT-identical to
+  ``TransformerLM.generate``'s compiled scan for the same prompt
+  (both sides padded to the same prompt bucket — XLA CPU kernels
+  differ per batch shape, so the comparison must hold the shape
+  fixed);
+* exactly one decode-executable compile per (bucket, capacity): a
+  warmed engine serves a staggered arrival/completion schedule that
+  sweeps occupancy 1..capacity under ``zoolint.sanitize(max_compiles=
+  0)`` — admission and eviction are state writes, never recompiles;
+* fused-window dispatch (``step_fuse > 1``) changes per-dispatch
+  overhead, never the token stream;
+* EOS/max_new eviction frees slots for queued requests (admitted
+  count > capacity through one engine);
+* the crash net: a dispatcher death fails every live + queued stream
+  with the original error and closes the engine to later submits.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models import TransformerLM
+from analytics_zoo_tpu.pipeline.inference import (DecodeEngine,
+                                                  DecodeEngineClosedError,
+                                                  InferenceModel)
+from analytics_zoo_tpu.pipeline.inference.decode import TokenStream
+from analytics_zoo_tpu.serving import ModelRegistry
+from analytics_zoo_tpu.serving.metrics import registry_families
+
+VOCAB, SEQ, BUCKET = 64, 48, 16
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = TransformerLM(vocab_size=VOCAB, seq_len=SEQ, n_layers=2,
+                          d_model=32, n_heads=2)
+    model.ensure_inference_ready()
+    return model
+
+
+@pytest.fixture(scope="module")
+def engine(lm):
+    """One shared warmed engine (capacity 3, one prompt bucket) for the
+    read-only tests; tests that mutate engine internals build their
+    own."""
+    eng = DecodeEngine(lm.trainer.state.params, lm.hyper, capacity=3,
+                       max_len=SEQ, prompt_buckets=(BUCKET,))
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+def scan_ref(lm, prompt, max_new):
+    """The scan-path comparator: same prompt padded to the SAME bucket
+    the engine uses (same compiled shape -> bit-comparable)."""
+    L = len(prompt)
+    padded = np.zeros((1, BUCKET), np.int32)
+    padded[0, :L] = prompt
+    full = lm.generate(padded, max_new_tokens=max_new, temperature=0.0,
+                       prompt_lengths=np.array([L]))
+    return np.asarray(full[0, L:L + max_new], np.int32)
+
+
+# ---------------------------------------------------------------- equivalence
+def test_step_decode_matches_scan_decode(lm, engine):
+    """Satellite 1: a slot stepped one token at a time is bit-identical
+    to the compiled-scan generate for the same (ragged) prompts —
+    including prompts decoded CONCURRENTLY in neighboring slots, which
+    is the whole point of the per-slot masking."""
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, VOCAB, int(n))
+               for n in (3, 7, BUCKET, 5, 11, 2)]
+    max_news = [9, 4, 12, 7, 3, 12]
+    outs = engine.generate(prompts, max_news, timeout=120)
+    for p, mn, out in zip(prompts, max_news, outs):
+        ref = scan_ref(lm, p, mn)
+        assert np.array_equal(out, ref), (p.tolist(), out, ref)
+
+
+def test_fused_windows_change_overhead_not_tokens(lm):
+    """step_fuse=1 (pure per-step) and step_fuse=4 (fused ladder)
+    produce identical streams — fusion may never cross a scheduling
+    event, so the schedule (and the tokens) are invariant."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, VOCAB, int(n)) for n in (4, 9, 6, 13)]
+    max_news = [11, 5, 8, 2]
+    outs = {}
+    for fuse in (1, 4):
+        eng = DecodeEngine(lm.trainer.state.params, lm.hyper,
+                           capacity=2, max_len=SEQ,
+                           prompt_buckets=(BUCKET,), step_fuse=fuse)
+        try:
+            eng.warmup()
+            outs[fuse] = eng.generate(prompts, max_news, timeout=120)
+            if fuse == 4:
+                assert eng.stats()["fused_dispatches"] > 0
+        finally:
+            eng.close()
+    for a, b in zip(outs[1], outs[4]):
+        assert np.array_equal(a, b)
+
+
+def test_eos_evicts_early_and_is_included(lm, engine):
+    """EOS stops the slot's stream AT the EOS token (included), exactly
+    where the scan path's continuation first emits it."""
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, VOCAB, 6)
+    ref = scan_ref(lm, prompt, 12)
+    eos = int(ref[4])
+    stop = int(np.argmax(ref == eos))  # first occurrence
+    out = engine.generate([prompt], [12], eos_id=eos, timeout=120)[0]
+    assert np.array_equal(out, ref[:stop + 1])
+    assert int(out[-1]) == eos
+
+
+# ------------------------------------------------------------- compile pin
+def test_one_compile_per_plan_at_every_occupancy(lm, zoolint_sanitize):
+    """The acceptance-criteria pin: a warmed engine serves a staggered
+    schedule that holds occupancy at EVERY level 1..capacity (ramping
+    up and draining down) with ZERO further XLA compiles — the
+    sanitizer's exact compile counter is the witness.  Transfer guards
+    ride along: every host<->device hop in the loop must be explicit.
+    """
+    capacity = 3
+    eng = DecodeEngine(lm.trainer.state.params, lm.hyper,
+                       capacity=capacity, max_len=SEQ,
+                       prompt_buckets=(BUCKET,))
+    eng.warmup()
+    rng = np.random.default_rng(0)
+    try:
+        with zoolint_sanitize(max_compiles=0):
+            # deterministic occupancy sweep: for k = 1..capacity run k
+            # concurrent requests to completion (occupancy exactly k
+            # while they decode), then ramp DOWN through staggered
+            # completions: capacity concurrent requests with strictly
+            # increasing max_new, so the batch thins capacity -> 1
+            # as short members evict and nothing refills
+            for k in range(1, capacity + 1):
+                streams = [eng.submit(rng.integers(0, VOCAB, 4 + i), 6)
+                           for i in range(k)]
+                for s in streams:
+                    assert s.result(timeout=120).shape == (6,)
+            streams = [eng.submit(rng.integers(0, VOCAB, 5),
+                                  4 * (i + 1))
+                       for i in range(capacity)]
+            for i, s in enumerate(streams):
+                assert s.result(timeout=120).shape == (4 * (i + 1),)
+        stats = eng.stats()
+        assert stats["prefill_misses"] == {BUCKET: 1}
+        assert stats["admitted"] == sum(range(1, capacity + 1)) + capacity
+        assert stats["slots_active"] == 0
+    finally:
+        eng.close()
+
+
+def test_slots_recycle_beyond_capacity(engine):
+    """More live requests than slots: eviction frees slots for queued
+    requests mid-run, every stream completes, bookkeeping balances."""
+    before = engine.stats()
+    rng = np.random.default_rng(5)
+    n = 10  # > 3x capacity
+    prompts = [rng.integers(0, VOCAB, int(rng.integers(2, BUCKET + 1)))
+               for _ in range(n)]
+    max_news = [int(rng.integers(1, 10)) for _ in range(n)]
+    outs = engine.generate(prompts, max_news, timeout=120)
+    assert [len(o) for o in outs] == max_news
+    after = engine.stats()
+    assert after["admitted"] - before["admitted"] == n
+    assert after["evicted"] - before["evicted"] == n
+    assert after["slots_active"] == 0
+    assert after["queued"] == 0
+    # a second pass over the same bucket must be pure cache hits
+    assert after["prefill_misses"] == before["prefill_misses"]
+
+
+# ------------------------------------------------------------- streaming API
+def test_token_stream_iterates_incrementally(lm, engine):
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(0, VOCAB, 8)
+    ref = scan_ref(lm, prompt, 10)
+    stream = engine.submit(prompt, 10)
+    got = list(stream)
+    assert np.array_equal(np.asarray(got, np.int32), ref)
+    assert stream.done
+    # result() after exhaustion returns the same tokens
+    assert np.array_equal(stream.result(timeout=1), ref)
+
+
+def test_token_stream_result_timeout(engine):
+    s = TokenStream(request_id=1)  # never finished by anyone
+    with pytest.raises(TimeoutError):
+        s.result(timeout=0.05)
+
+
+def test_submit_validation(engine):
+    with pytest.raises(ValueError, match="non-empty 1-D"):
+        engine.submit(np.zeros((2, 3), np.int32), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        engine.submit([1, 2, 3], 0)
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        engine.submit(np.zeros(BUCKET + 1, np.int32), 4)
+    with pytest.raises(ValueError, match="exceeds"):
+        engine.submit(np.zeros(BUCKET, np.int32), SEQ)  # > max_len
+
+
+def test_generate_batch_validation_is_all_or_nothing(engine):
+    """A bad late row must fail the WHOLE batch before any row is
+    queued — otherwise earlier rows decode into abandoned streams,
+    burning slots the caller gave up on."""
+    before = engine.stats()
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        engine.generate([np.ones(4, np.int32),
+                         np.zeros(BUCKET + 1, np.int32)], 4)
+    assert engine.stats()["admitted"] == before["admitted"]
+
+
+def test_engine_config_validation(lm):
+    params, hyper = lm.trainer.state.params, lm.hyper
+    with pytest.raises(ValueError, match="capacity"):
+        DecodeEngine(params, hyper, capacity=0)
+    with pytest.raises(ValueError, match="positional table"):
+        DecodeEngine(params, hyper, capacity=1, max_len=SEQ + 1)
+    with pytest.raises(ValueError, match="room to decode"):
+        DecodeEngine(params, hyper, capacity=1, max_len=8,
+                     prompt_buckets=(8,))
+
+
+# ---------------------------------------------------------------- lifecycle
+def test_close_drains_then_rejects(lm):
+    eng = DecodeEngine(lm.trainer.state.params, lm.hyper, capacity=2,
+                       max_len=SEQ, prompt_buckets=(BUCKET,))
+    eng.warmup()
+    rng = np.random.default_rng(1)
+    streams = [eng.submit(rng.integers(0, VOCAB, 4), 8)
+               for _ in range(4)]  # 2 queued behind 2 active
+    eng.close()
+    # graceful drain: everything submitted BEFORE close completes
+    for s in streams:
+        assert s.result(timeout=120).shape == (8,)
+    with pytest.raises(DecodeEngineClosedError):
+        eng.submit(rng.integers(0, VOCAB, 4), 2)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_crash_net_fails_all_streams(lm):
+    eng = DecodeEngine(lm.trainer.state.params, lm.hyper, capacity=2,
+                       max_len=SEQ, prompt_buckets=(BUCKET,))
+    eng.warmup()
+    boom = RuntimeError("injected decode crash")
+
+    def exploding(*a, **kw):
+        raise boom
+
+    eng._step_fn = exploding
+    eng._stepk_fns = {k: exploding for k in eng._stepk_fns}
+    rng = np.random.default_rng(2)
+    streams = [eng.submit(rng.integers(0, VOCAB, 4), 8)
+               for _ in range(4)]
+    for s in streams:
+        with pytest.raises(RuntimeError, match="injected decode crash"):
+            s.result(timeout=60)
+    # the engine is dead: later submits must not strand
+    deadline = time.time() + 10
+    while not eng.closed and time.time() < deadline:
+        time.sleep(0.02)
+    with pytest.raises(DecodeEngineClosedError):
+        eng.submit(rng.integers(0, VOCAB, 4), 2)
+
+
+def test_concurrent_submitters(lm, engine):
+    """Many threads streaming through one engine: per-thread outputs
+    stay bit-exact vs the scan path (no cross-request bleed)."""
+    rng = np.random.default_rng(17)
+    cases = [(rng.integers(0, VOCAB, int(rng.integers(2, 12))),
+              int(rng.integers(1, 9))) for _ in range(8)]
+    refs = [scan_ref(lm, p, mn) for p, mn in cases]
+    outs = [None] * len(cases)
+    errs = []
+
+    def worker(i):
+        try:
+            outs[i] = engine.submit(cases[i][0], cases[i][1]) \
+                .result(timeout=120)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(cases))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errs, errs
+    for out, ref in zip(outs, refs):
+        assert np.array_equal(out, ref)
+
+
+def test_unwarmed_engine_serves_and_late_warmup_raises(lm):
+    """The dispatcher starts lazily at the first submit, so an
+    unwarmed engine serves (paying its compiles inline), and a warmup
+    AFTER serving began — which would rebind the donated decode state
+    under a live dispatcher — is refused instead of racing."""
+    eng = DecodeEngine(lm.trainer.state.params, lm.hyper, capacity=2,
+                       max_len=SEQ, prompt_buckets=(BUCKET,))
+    try:
+        rng = np.random.default_rng(31)
+        prompt = rng.integers(0, VOCAB, 5)
+        out = eng.submit(prompt, 4).result(timeout=120)
+        assert np.array_equal(out, scan_ref(lm, prompt, 4))
+        assert eng.stats()["prefill_misses"] == {BUCKET: 1}
+        with pytest.raises(RuntimeError, match="before the first"):
+            eng.warmup()
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------------- serving integration
+def test_inference_model_generate_wiring(lm):
+    im = InferenceModel(supported_concurrent_num=2, decode_capacity=2,
+                        decode_prompt_buckets=(BUCKET,))
+    im.load_keras_net(lm)
+    try:
+        rng = np.random.default_rng(23)
+        prompts = [rng.integers(0, VOCAB, 5), rng.integers(0, VOCAB, 9)]
+        outs = im.generate(prompts, [6, 3], timeout=120)
+        assert np.array_equal(outs[0], scan_ref(lm, prompts[0], 6))
+        assert np.array_equal(outs[1], scan_ref(lm, prompts[1], 3))
+        stream = im.generate_stream(prompts[0], 6)
+        assert np.array_equal(stream.result(timeout=120), outs[0])
+        stats = im.serving_stats()
+        assert stats["decode"]["capacity"] == 2
+        assert stats["decode"]["tokens"] >= 15
+    finally:
+        im.close()
+
+
+def test_inference_model_without_engine_raises(lm):
+    im = InferenceModel(supported_concurrent_num=1)
+    im.load_keras_net(lm)
+    try:
+        with pytest.raises(RuntimeError, match="no decode engine"):
+            im.generate([[1, 2, 3]], 4)
+    finally:
+        im.close()
+
+
+def test_decode_capacity_requires_lm():
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    net = Sequential()
+    net.add(Dense(4, input_shape=(3,)))
+    im = InferenceModel(supported_concurrent_num=1, decode_capacity=2)
+    with pytest.raises(ValueError, match="generation-capable"):
+        im.load_keras_net(net)
+
+
+def test_failed_reload_leaves_handle_on_old_version(lm):
+    """A reload whose decode-engine build fails must leave BOTH planes
+    on the old version — a half-swapped handle (new predict plane,
+    stale generate engine) is the one state no caller can reason
+    about."""
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    im = InferenceModel(supported_concurrent_num=1, decode_capacity=2,
+                        decode_prompt_buckets=(BUCKET,))
+    im.load_keras_net(lm)
+    try:
+        rng = np.random.default_rng(37)
+        prompt = rng.integers(0, VOCAB, 5)
+        before = im.generate([prompt], [4], timeout=120)[0]
+        old_engine = im.decode_engine
+        bad = Sequential()
+        bad.add(Dense(4, input_shape=(3,)))
+        with pytest.raises(ValueError, match="generation-capable"):
+            im.load_keras_net(bad)  # validation fires BEFORE any swap
+        assert im.decode_engine is old_engine
+        assert not old_engine.closed
+        after = im.generate([prompt], [4], timeout=120)[0]
+        assert np.array_equal(before, after)
+        # the predict plane still serves the LM graph too, not Dense
+        out = im.predict(np.zeros((1, BUCKET), np.int32))
+        assert np.asarray(out).shape[-1] == VOCAB
+    finally:
+        im.close()
+
+
+def test_registry_generate_and_decode_families(lm):
+    from analytics_zoo_tpu.observability import Tracer
+
+    tracer = Tracer()
+    reg = ModelRegistry(tracer=tracer)
+    try:
+        reg.deploy("lm", lm, decode_capacity=2,
+                   decode_prompt_buckets=(BUCKET,))
+        rng = np.random.default_rng(29)
+        prompt = rng.integers(0, VOCAB, 6)
+        out, info = reg.generate_ex("lm", [prompt], 5)
+        assert np.array_equal(out[0], scan_ref(lm, prompt, 5))
+        assert info["model"] == "lm" and info["version"] == 1
+        # the span carries the decode phase taxonomy
+        trace = tracer.find(info["request_id"])
+        phases = {p["name"] for p in trace["phases"]}
+        assert {"prefill", "decode_step"} <= phases, phases
+        # control-plane counters tick on the generate path too
+        snap = reg.metrics("lm")["lm"]
+        assert snap["versions"][1]["requests"] == 1
+        # satellite 2: the Prometheus bridge exports the decode
+        # families off the same snapshot
+        fams = {f.name: f for f in registry_families(reg.metrics())}
+        for name in ("zoo_decode_tokens_total", "zoo_decode_steps_total",
+                     "zoo_decode_slot_occupancy",
+                     "zoo_decode_slot_capacity"):
+            assert name in fams, name
+        (tok_labels, tok_v), = fams["zoo_decode_tokens_total"].samples
+        assert tok_labels["model"] == "lm" and tok_v == 5
+        (cap_labels, cap_v), = fams["zoo_decode_slot_capacity"].samples
+        assert cap_labels["model"] == "lm" and cap_v == 2
+        assert fams["zoo_decode_tokens_total"].mtype == "counter"
+        assert fams["zoo_decode_slot_occupancy"].mtype == "gauge"
+    finally:
+        reg.shutdown()
