@@ -24,7 +24,7 @@ from ...observability import profile as _profile
 from ...observability import trace as _trace
 from .decode import DecodeEngine
 from .serving import (BucketedExecutableCache, CoalescerClosedError,
-                      ReplicaSet, RequestCoalescer, _rows)
+                      ReplicaSet, RequestCoalescer, _execstore, _rows)
 
 
 class JTensor:
@@ -327,7 +327,15 @@ class InferenceModel:
         replica_set = None
         if self._bucketing and not getattr(self, "_quantize_flag", False):
             n_rep = self._resolve_replicas()
-            if n_rep > 1 and replica_fn is not None:
+            # the raw-dispatch ReplicaSet path engages for N > 1
+            # devices, and ALSO single-device whenever the persistent
+            # executable store is enabled: the store serves serialized
+            # raw executables, and only the replica path dispatches
+            # them — this is what makes a warm-store deploy()
+            # zero-compile even on one device.  Store off, one device:
+            # the closure-jit path of PR 1, bit-for-bit unchanged.
+            store_on = _execstore().current() is not None
+            if (n_rep > 1 or store_on) and replica_fn is not None:
                 replica_set = ReplicaSet(
                     replica_fn, replica_params,
                     devices=jax.local_devices()[:n_rep])

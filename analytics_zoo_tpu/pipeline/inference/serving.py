@@ -59,6 +59,14 @@ from ...observability.metrics import LatencyWindow as _LatencyWindow
 _slog = _get_logger("zoo.serving")
 
 
+def _execstore():
+    """The persistent-executable-store module, imported lazily: the
+    data plane must stay importable on its own, and the store is
+    consulted only at compile/warmup time anyway."""
+    from ...serving import execstore
+    return execstore
+
+
 def bucket_ladder(max_batch: int, growth: float = 2.0,
                   min_batch: int = 1) -> Tuple[int, ...]:
     """The geometric ladder of padded batch sizes: ``min_batch`` scaled
@@ -181,6 +189,14 @@ class ReplicaSet:
     (``kept_var_idx``) are dropped to match the executable's parameter
     list.
 
+    Persistence: with the executable store enabled
+    (:mod:`analytics_zoo_tpu.serving.execstore`), ``ensure_compiled``
+    is read-through/write-behind against it — a process whose store
+    already holds this (graph, weights, signature, jax version,
+    device kind) fingerprint LOADS the executable in milliseconds and
+    fires no compile event at all, which is what makes a second
+    process's ``deploy()`` zero-compile.
+
     Fault handling: a replica whose dispatch raises is marked unhealthy
     and the failed dispatch is retried once on another healthy replica
     by the owning cache.  Recovery is structured, not luck: an
@@ -203,7 +219,8 @@ class ReplicaSet:
 
     def __init__(self, fn: Callable, params, devices=None,
                  probe_backoff_s: float = 0.5,
-                 probe_backoff_max_s: float = 30.0):
+                 probe_backoff_max_s: float = 30.0,
+                 store="auto"):
         self._fn = fn
         # one jit wrapper for the whole set: every bucket's lowering
         # comes from it (a per-compile jax.jit would re-trace per call)
@@ -216,6 +233,20 @@ class ReplicaSet:
         # per-dispatch upload is the padded batch alone
         placed0 = jax.device_put(params, devs[0])
         self._params_r0 = placed0
+        # persistent executable store (read-through under
+        # ensure_compiled, write-behind after each compile): "auto"
+        # resolves the process store — None when none is configured,
+        # which keeps every store branch below inert
+        if store == "auto":
+            store = _execstore().current()
+        self._store = store
+        # the weights are runtime ARGUMENTS of the replica executable,
+        # so the compiled code is weight-agnostic — but the store key
+        # must rotate on a weight change anyway: a redeploy with new
+        # weights must never be answered by an entry recorded against
+        # old ones.  Hashed once per set, at construction.
+        self._wdigest = (_execstore().params_digest(placed0)
+                         if store is not None else None)
         replicas = [Replica(0, devs[0], jax.tree_util.tree_leaves(placed0))]
         for i, d in enumerate(devs[1:], start=1):
             replicas.append(Replica(
@@ -267,18 +298,43 @@ class ReplicaSet:
         """How many distinct signatures hold a placed executable."""
         return len(self._exes)
 
+    def _load_serialized(self, ser: bytes, device):
+        """Load serialized-executable bytes onto ``device``: fresh
+        single-device CompileOptions with only the device assignment
+        set — the PR 5 round trip, now also how a store entry
+        rehydrates (it works with no original executable in hand).  A
+        load, not a compile: no ``backend_compile`` event fires."""
+        opts = _xla_client.CompileOptions()
+        opts.device_assignment = _xla_client.DeviceAssignment.create(
+            np.array([[device.id]], dtype=np.int32))
+        return self._backend.deserialize_executable(ser, opts)
+
     def ensure_compiled(self, batched, key: Optional[Tuple] = None
                         ) -> float:
-        """Compile the executable for ``batched``'s signature once and
-        place it on every replica.  Returns the wall seconds spent
-        (0.0 when the signature was already placed).  Safe to call from
-        several threads — concurrent DIFFERENT signatures compile in
-        parallel (warmup's thread pool relies on this), the same
-        signature compiles exactly once.  Callers on the dispatch path
-        call this UNCONDITIONALLY (warm cost: one dict membership
-        check): placement here is the authority, not any caller-side
-        seen-bit — a concurrent cold dispatch may still be mid-compile,
-        and a compile that failed once must be retryable."""
+        """Make the executable for ``batched``'s signature available
+        on every replica — compiled once, or LOADED from the
+        persistent executable store when a prior process (or deploy)
+        already compiled the identical computation.  Returns the wall
+        seconds spent (0.0 when the signature was already placed).
+        Safe to call from several threads — concurrent DIFFERENT
+        signatures compile in parallel (warmup's thread pool relies on
+        this), the same signature compiles exactly once.  Callers on
+        the dispatch path call this UNCONDITIONALLY (warm cost: one
+        dict membership check): placement here is the authority, not
+        any caller-side seen-bit — a concurrent cold dispatch may
+        still be mid-compile, and a compile that failed once must be
+        retryable.
+
+        Store protocol (read-through / write-behind): the fingerprint
+        covers the lowered HLO (graph + padded signature), the weights
+        digest, and the runtime environment, so a hit is the SAME
+        computation by construction; the entry carries
+        ``_kept_var_idx`` so the raw dispatch path rehydrates without
+        touching the compiled object's jax wrapper.  Any lookup or
+        load failure falls back to the compile below — the store can
+        cost a recompile, never serve a wrong executable.  Lookups
+        happen only HERE, on the placement miss path — never on a
+        per-dispatch hot path."""
         if key is None:
             key = self._key(batched)
         if key in self._exes:
@@ -295,29 +351,83 @@ class ReplicaSet:
                 lambda a: jax.ShapeDtypeStruct(
                     np.asarray(a).shape, np.asarray(a).dtype, sharding=s0),
                 batched)
-            # the ONE traced lowering + XLA compile for this signature
-            # (this is the call the backend_compile counter sees)
-            compiled = self._jit.lower(self._params_r0, specs).compile()
-            mexe = compiled._executable
-            exe0 = mexe.xla_executable
+            # tracing + lowering runs on BOTH paths (it fires no
+            # backend_compile event): on a store hit it only feeds the
+            # fingerprint, on a miss it is the compile's input
+            lowered = self._jit.lower(self._params_r0, specs)
             n_in = self._n_param_leaves \
                 + len(jax.tree_util.tree_leaves(specs))
-            kept = getattr(mexe, "_kept_var_idx", None)
-            kept_t = (None if kept is None or len(kept) == n_in
-                      else tuple(sorted(kept)))
+            store = self._store
+            fp = None
+            exe0 = None
+            kept_t: Optional[Tuple[int, ...]] = None
+            ser: Optional[bytes] = None
+            if store is not None:
+                fp = store.fingerprint(
+                    "replica-forward", _execstore().hlo_digest(lowered),
+                    self._wdigest, key, device=dev0)
+                ent = store.lookup(fp)
+                if ent is not None:
+                    try:
+                        kept_t = ent.meta.get("kept")
+                        if kept_t is not None:
+                            # () is legitimate — an executable whose
+                            # inputs all constant-folded away keeps
+                            # zero of them; only out-of-RANGE indices
+                            # indict the entry
+                            kept_t = tuple(int(i) for i in kept_t)
+                            if any(i < 0 or i >= n_in
+                                   for i in kept_t):
+                                raise ValueError(
+                                    f"kept indices {kept_t} out of "
+                                    f"range for {n_in} inputs")
+                        ser = ent.payload
+                        exe0 = self._load_serialized(ser, dev0)
+                    except Exception as e:  # noqa: BLE001 — ANY load
+                        # failure (truncated bytes, foreign artifact,
+                        # bad metadata) must fall back to a fresh
+                        # compile: the store may cost a recompile,
+                        # never a wrong executable
+                        store.note_invalid(fp, e)
+                        exe0, kept_t, ser = None, None, None
+            if exe0 is None:
+                # the ONE traced lowering + XLA compile for this
+                # signature (this is the call the backend_compile
+                # counter sees)
+                compiled = lowered.compile()
+                mexe = compiled._executable
+                exe0 = mexe.xla_executable
+                kept = getattr(mexe, "_kept_var_idx", None)
+                kept_t = (None if kept is None or len(kept) == n_in
+                          else tuple(sorted(kept)))
+                if len(self.replicas) > 1:
+                    # multi-replica placement REQUIRES the bytes: a
+                    # serialize failure here fails the deploy exactly
+                    # as it did pre-store
+                    ser = self._backend.serialize_executable(exe0)
+                elif store is not None:
+                    # store-only serialization is best-effort: a
+                    # backend that cannot serialize must not fail a
+                    # deploy that just compiled successfully
+                    try:
+                        ser = self._backend.serialize_executable(exe0)
+                    except Exception as e:  # noqa: BLE001
+                        ser = None
+                        _slog.error("execstore_serialize_failed",
+                                    error=f"{type(e).__name__}: {e}")
+                if store is not None and ser is not None:
+                    # write-behind: the device-0 serialization the
+                    # multi-replica path produces anyway, plus the
+                    # metadata the raw dispatch path needs back
+                    store.put(fp, ser, meta={
+                        "kind": "replica-forward", "kept": kept_t,
+                        "n_in": n_in})
             exes = [exe0]
-            if len(self.replicas) > 1:
-                # place everywhere: serialize once, load per device
-                # with only the device assignment rewritten — a load,
-                # not a compile
-                ser = self._backend.serialize_executable(exe0)
-                for rep in self.replicas[1:]:
-                    opts = exe0.compile_options()
-                    opts.device_assignment = \
-                        _xla_client.DeviceAssignment.create(
-                            np.array([[rep.device.id]], dtype=np.int32))
-                    exes.append(
-                        self._backend.deserialize_executable(ser, opts))
+            # place everywhere: one serialization (from the compile or
+            # from the store entry), loaded per device with only the
+            # device assignment rewritten — a load, not a compile
+            for rep in self.replicas[1:]:
+                exes.append(self._load_serialized(ser, rep.device))
             out_tree = jax.tree_util.tree_structure(
                 jax.eval_shape(self._fn, self._params_r0, specs))
             with self._lock:

@@ -5,17 +5,20 @@ lifecycle layer over the ``pipeline.inference`` data plane (bucketed
 executables + request coalescing + replica sets).  See docs/serving.md
 §"Control plane" and §"Elasticity"."""
 
+from . import execstore
 from .admission import AdmissionController
 from .autoscale import Autoscaler, autoscaler_for
 from .errors import (DeadlineExceeded, DeployError, ModelNotFound,
                      Overloaded, ServingError, error_response)
+from .execstore import ExecStore
 from .metrics import (Counters, LatencyWindow, registry_collector,
                       registry_families)
 from .registry import ModelRegistry
 
 __all__ = [
     "AdmissionController", "Autoscaler", "Counters", "DeadlineExceeded",
-    "DeployError", "LatencyWindow", "ModelNotFound", "ModelRegistry",
-    "Overloaded", "ServingError", "autoscaler_for", "error_response",
-    "registry_collector", "registry_families",
+    "DeployError", "ExecStore", "LatencyWindow", "ModelNotFound",
+    "ModelRegistry", "Overloaded", "ServingError", "autoscaler_for",
+    "error_response", "execstore", "registry_collector",
+    "registry_families",
 ]

@@ -38,7 +38,11 @@ run on or block serving threads even though none is reachable from
 stalls EVERY live stream, not one request) and ``_admit_slot`` (the
 prefill + slot-insert path each arriving sequence rides); ``submit``
 was already an entry, so the TokenStream producer side is covered by
-the existing BFS.
+the existing BFS.  The persistent executable store adds ``lookup``
+(the read-through consult under a compile miss — it runs with a
+compile lock held, so a stray sync or free-text log there stalls
+every caller racing the same signature) and ``rehydrate`` (bytes back
+into a loaded executable, the path a warm deploy serves from).
 """
 
 from __future__ import annotations
@@ -52,7 +56,8 @@ from .findings import Finding
 DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        "dispatch_padded", "dispatch", "pack",
                        "tick", "_resolve_hedged", "maybe_reprobe",
-                       "_loop_inner", "_admit_slot")
+                       "_loop_inner", "_admit_slot",
+                       "lookup", "rehydrate")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

@@ -125,6 +125,14 @@ def build_registry():
     metrics.register_collector(tracer.families)
     prof = profile.install()
     metrics.register_collector(prof.families)
+    # persistent executable store (enabled via ZOO_EXECSTORE_DIR):
+    # zoo_execstore_{hit,miss,write,invalid,evicted}_total land in the
+    # same scrape, so a fleet dashboard can watch cold starts turn
+    # into disk loads
+    from analytics_zoo_tpu.serving import execstore
+    store = execstore.current()
+    if store is not None:
+        metrics.register_collector(store.families)
     registry.deploy(DEFAULT_MODEL, build_net(),
                     warmup_shapes=(N_FEATURES,))
     # the LM behind /generate: a continuous-batching DecodeEngine

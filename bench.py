@@ -2733,6 +2733,276 @@ def loadtest_bench(profile: str = "all", selfcheck: bool = False,
     return rc
 
 
+def _coldstart_config(quick: bool) -> dict:
+    """One shared model recipe for both coldstart children — the two
+    processes must build IDENTICAL computations (seeded params, fixed
+    shapes) or the store could never hit."""
+    if quick:
+        return {"mlp_layers": 24, "d_in": 64, "max_batch": 8,
+                "lm": {"vocab_size": 64, "seq_len": 96, "n_layers": 2,
+                       "d_model": 64, "n_heads": 4},
+                "prompt_bucket": 16, "capacity": 2, "max_new": 8,
+                "n_prompts": 4}
+    return {"mlp_layers": 64, "d_in": 64, "max_batch": 32,
+            "lm": {"vocab_size": 128, "seq_len": 160, "n_layers": 2,
+                   "d_model": 128, "n_heads": 4},
+            "prompt_bucket": 32, "capacity": 4, "max_new": 16,
+            "n_prompts": 8}
+
+
+def _coldstart_child(role: str, work: str, quick: bool) -> int:
+    """One coldstart process: deploy a predict-plane model through the
+    registry and warm a decode engine, counting ``backend_compile``
+    events inside EXACTLY the two gated windows — ``deploy()`` and
+    ``DecodeEngine.warmup()``.  The ``cold`` role runs against an
+    empty store (its compiles populate it) and records expected
+    outputs; the ``warm`` role runs in a FRESH process against the
+    warmed store and must show 0 compiles in both windows with
+    bit-identical outputs.  The store engages via ZOO_EXECSTORE_DIR
+    alone (set by the parent) — the zero-code fleet recipe.
+
+    Prints one ``COLDSTART_CHILD {json}`` line for the parent."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax._src import monitoring
+
+    events = []
+    monitoring.register_event_duration_secs_listener(
+        lambda k, d, **kw: (events.append(k)
+                            if "backend_compile" in k else None))
+
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.pipeline.inference.decode import DecodeEngine
+    from analytics_zoo_tpu.serving import ModelRegistry, execstore
+
+    store = execstore.current()
+    if store is None:
+        _log("coldstart child: ZOO_EXECSTORE_DIR not set/honored")
+        return 2
+    cfg = _coldstart_config(quick)
+    res = {"role": role}
+
+    # ---- predict plane: registry deploy of a seeded MLP ----
+    rng = np.random.default_rng(0)
+    n_layers, d_in = cfg["mlp_layers"], cfg["d_in"]
+    params = {f"w{i}": rng.normal(size=(d_in, d_in)).astype(np.float32)
+              * 0.1 for i in range(n_layers)}
+
+    def mlp(p, x):
+        h = x
+        for i in range(n_layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return h
+
+    reg = ModelRegistry(replicas="all", max_batch_size=cfg["max_batch"])
+    c0, t0 = len(events), time.perf_counter()
+    reg.deploy("coldstart-mlp", jax_fn=mlp, params=params,
+               warmup_shapes=(d_in,))
+    res["deploy_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    res["deploy_compiles"] = len(events) - c0
+
+    x = rng.normal(size=(cfg["max_batch"] // 2, d_in)
+                   ).astype(np.float32)
+    out = np.asarray(reg.predict("coldstart-mlp", x))
+    expect = os.path.join(work, "predict_expect.npy")
+    if role == "cold":
+        np.save(expect, out)
+        res["predict_bitexact"] = True
+    else:
+        res["predict_bitexact"] = bool(
+            np.array_equal(out, np.load(expect)))
+
+    # ---- decode plane: engine warmup (the second gated window) ----
+    lm = TransformerLM(**cfg["lm"])
+    trainer = lm.ensure_inference_ready()
+    prompts = [rng.integers(0, cfg["lm"]["vocab_size"],
+                            int(rng.integers(4, cfg["prompt_bucket"])))
+               for _ in range(cfg["n_prompts"])]
+    # engine CONSTRUCTION sits outside the gated window on purpose:
+    # building the device slot array is jnp.zeros fills (trivial fill
+    # programs XLA still counts as compiles) — state allocation, not
+    # plan compilation, and not something a store could ever serve
+    engine = DecodeEngine(trainer.state.params, lm.hyper,
+                          capacity=cfg["capacity"],
+                          max_len=cfg["lm"]["seq_len"],
+                          prompt_buckets=(cfg["prompt_bucket"],))
+    c1, t1 = len(events), time.perf_counter()
+    engine.warmup()
+    res["decode_warmup_ms"] = round((time.perf_counter() - t1) * 1e3, 1)
+    res["decode_warmup_compiles"] = len(events) - c1
+
+    outs = engine.generate(prompts, cfg["max_new"], timeout=300)
+    dec_expect = os.path.join(work, "decode_expect.npz")
+    if role == "cold":
+        np.savez(dec_expect, *outs)
+        res["decode_bitexact"] = True
+    else:
+        with np.load(dec_expect) as z:
+            res["decode_bitexact"] = bool(
+                len(z.files) == len(outs)
+                and all(np.array_equal(outs[i], z[f"arr_{i}"])
+                        for i in range(len(outs))))
+    engine.close()
+    reg.shutdown()
+    res["total_compiles"] = len(events)
+    res["store"] = {k: v for k, v in store.stats().items()
+                    if k in ("hit", "miss", "write", "invalid",
+                             "entries", "bytes")}
+    print("COLDSTART_CHILD " + json.dumps(res), flush=True)
+    return 0
+
+
+def _write_coldstart_trajectory(results: dict, rc: int) -> str:
+    """Append this run to the BENCH_COLDSTART_r*.json trajectory
+    (deploy-time ms cold vs warm-store + compile counts accumulate
+    across PRs, same file shape as the loadtest trajectory)."""
+    import re as _re
+
+    ns = []
+    for p in glob.glob(os.path.join(REPO, "BENCH_COLDSTART_r*.json")):
+        m = _re.search(r"BENCH_COLDSTART_r(\d+)\.json$", p)
+        if m:
+            ns.append(int(m.group(1)))
+    n = max(ns, default=0) + 1
+    path = os.path.join(REPO, f"BENCH_COLDSTART_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"n": n,
+                   "cmd": "python bench.py coldstart "
+                          + " ".join(sys.argv[2:]),
+                   "rc": rc, "parsed": results}, f, indent=2)
+    return path
+
+
+def coldstart_bench(quick: bool = False, selfcheck: bool = False,
+                    out_path: str = None) -> int:
+    """Two-process cold-start gate for the persistent executable store
+    (``bench.py coldstart``): a COLD child deploys + decode-warms
+    against an empty store (its compiles populate it) and exits; a
+    WARM child — a genuinely fresh process, nothing shared but the
+    store directory — repeats the identical deploy and must record
+    EXACTLY 0 ``backend_compile`` events inside ``deploy()`` and
+    ``DecodeEngine.warmup()``, with outputs bit-identical to the cold
+    child's (forced host devices, same padded buckets).  Deploy
+    wall-time ratios are reported informationally (perf-flake
+    policy); the gates are the compile counts, bit-exactness, and a
+    clean store (0 invalid entries)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="zoo_coldstart_")
+    results = {"quick": quick,
+               "config": _coldstart_config(quick)}
+    ok = True
+    try:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["ZOO_EXECSTORE_DIR"] = os.path.join(work, "execstore")
+        if "host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=2").strip()
+
+        def run_child(role: str) -> dict:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "coldstart", "--_child", role, "--work", work]
+            if quick:
+                cmd.append("--quick")
+            _log(f"coldstart: launching {role} child")
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=900, env=env, cwd=REPO)
+            for line in proc.stdout.splitlines():
+                if line.startswith("COLDSTART_CHILD "):
+                    return json.loads(line[len("COLDSTART_CHILD "):])
+            raise RuntimeError(
+                f"coldstart {role} child produced no report "
+                f"(rc={proc.returncode}):\n--- stdout:\n"
+                f"{proc.stdout[-2000:]}\n--- stderr:\n"
+                f"{proc.stderr[-2000:]}")
+
+        cold = run_child("cold")
+        warm = run_child("warm")
+        results["cold"] = cold
+        results["warm"] = warm
+        dep_ratio = round(cold["deploy_ms"]
+                          / max(warm["deploy_ms"], 1e-9), 2)
+        dec_ratio = round(cold["decode_warmup_ms"]
+                          / max(warm["decode_warmup_ms"], 1e-9), 2)
+        results["deploy_ratio"] = dep_ratio
+        results["decode_warmup_ratio"] = dec_ratio
+
+        zero = (warm["deploy_compiles"] == 0
+                and warm["decode_warmup_compiles"] == 0)
+        # the zero gate proves nothing unless the cold side actually
+        # compiled inside the same windows
+        vacuous = (cold["deploy_compiles"] == 0
+                   or cold["decode_warmup_compiles"] == 0)
+        bitexact = (warm["predict_bitexact"]
+                    and warm["decode_bitexact"])
+        clean = (warm["store"]["invalid"] == 0
+                 and warm["store"]["hit"] > 0)
+        print(f"COLDSTART_DEPLOY cold_ms={cold['deploy_ms']} "
+              f"warm_ms={warm['deploy_ms']} ratio={dep_ratio}x",
+              flush=True)
+        print(f"COLDSTART_DECODE_WARMUP "
+              f"cold_ms={cold['decode_warmup_ms']} "
+              f"warm_ms={warm['decode_warmup_ms']} ratio={dec_ratio}x",
+              flush=True)
+        print(f"COLDSTART_ZERO_COMPILE "
+              f"deploy={warm['deploy_compiles']} "
+              f"decode_warmup={warm['decode_warmup_compiles']} "
+              f"cold_deploy={cold['deploy_compiles']} "
+              + ("PASS" if zero and not vacuous else "FAIL"),
+              flush=True)
+        print(f"COLDSTART_BITEXACT "
+              f"predict={warm['predict_bitexact']} "
+              f"decode={warm['decode_bitexact']}", flush=True)
+        if selfcheck:
+            if not zero:
+                _log("coldstart FAIL: warm process compiled inside a "
+                     "gated window — the store did not serve it")
+                ok = False
+            if vacuous:
+                _log("coldstart FAIL: cold child recorded no compiles "
+                     "— the zero-compile gate measured nothing")
+                ok = False
+            if not bitexact:
+                _log("coldstart FAIL: store-loaded executables "
+                     "diverged from freshly-compiled outputs")
+                ok = False
+            if not clean:
+                _log(f"coldstart FAIL: store not clean in the warm "
+                     f"process: {warm['store']}")
+                ok = False
+            if ok:
+                _log(f"coldstart selfcheck: 0 compiles warm, "
+                     f"bit-exact, deploy {dep_ratio}x faster, decode "
+                     f"warmup {dec_ratio}x faster")
+    except (RuntimeError, subprocess.TimeoutExpired,
+            json.JSONDecodeError) as e:
+        _log(f"coldstart FAIL: {type(e).__name__}: {e}")
+        results["error"] = f"{type(e).__name__}: {e}"
+        ok = False
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    print("BENCH_COLDSTART " + json.dumps(results), flush=True)
+    rc = 0 if (ok or not selfcheck) else 1
+    if not quick and "error" not in results:
+        # only full runs enter the trajectory (a --quick smoke run
+        # would archive an incomparable baseline)
+        path = _write_coldstart_trajectory(results, rc)
+        _log(f"coldstart trajectory written: {os.path.basename(path)}")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+    if selfcheck:
+        print("COLDSTART_SELFCHECK_" + ("OK" if ok else "FAIL"),
+              flush=True)
+    return rc
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
@@ -2769,6 +3039,21 @@ if __name__ == "__main__":
         sys.exit(decode_bench(quick="--quick" in sys.argv,
                               selfcheck="--selfcheck" in sys.argv,
                               out_path=_out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "coldstart":
+        if "--_child" in sys.argv:
+            # one coldstart process (spawned by the parent below):
+            # JAX_PLATFORMS / XLA_FLAGS / ZOO_EXECSTORE_DIR arrive via
+            # the environment, so jax initializes exactly as forced
+            _role = sys.argv[sys.argv.index("--_child") + 1]
+            _work = sys.argv[sys.argv.index("--work") + 1]
+            sys.exit(_coldstart_child(_role, _work,
+                                      quick="--quick" in sys.argv))
+        _out = None
+        if "--out" in sys.argv:
+            _out = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(coldstart_bench(quick="--quick" in sys.argv,
+                                 selfcheck="--selfcheck" in sys.argv,
+                                 out_path=_out))
     elif len(sys.argv) > 1 and sys.argv[1] == "loadtest":
         # the elastic gates need >1 device: force 2 virtual host
         # devices BEFORE jax initializes (no-op when the caller — the

@@ -77,4 +77,24 @@ grep -q "DECODE_SELFCHECK_OK" <<<"$dc" || {
     echo "smoke FAIL: decode selfcheck gates failed" >&2
     exit 1
 }
+
+# Persistent-executable-store gate: the two-process cold-start leg.
+# bench.py coldstart spawns a FIRST process that deploys (and
+# decode-warms) against an empty store and exits, then a SECOND fresh
+# process that repeats the identical deploy against the warmed store —
+# which must record exactly 0 backend_compile events inside deploy()
+# and DecodeEngine.warmup(), with outputs bit-identical to the first
+# process's.
+cs=$(timeout -k 10 590 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python bench.py coldstart --quick --selfcheck)
+printf '%s\n' "$cs"
+grep -Eq "COLDSTART_ZERO_COMPILE deploy=0 decode_warmup=0 .*PASS" <<<"$cs" || {
+    echo "smoke FAIL: warm-store second process was not zero-compile" >&2
+    exit 1
+}
+grep -q "COLDSTART_SELFCHECK_OK" <<<"$cs" || {
+    echo "smoke FAIL: coldstart selfcheck gates failed" >&2
+    exit 1
+}
 echo "serving smoke OK"
