@@ -153,8 +153,12 @@ class ModelRegistry:
             return e
 
     def models(self) -> Dict[str, Optional[int]]:
-        """name -> active version (None while only a canary is staged)."""
-        return {n: (e.active.version if e.active else None)
+        """name -> active version (None while only a canary is staged).
+        Single read per entry: a concurrent undeploy/shutdown nulls
+        ``e.active`` at any moment, and a check-then-deref here would
+        crash the listing (zoolint ZL721)."""
+        return {n: (dep.version if (dep := e.active) is not None
+                    else None)
                 for n, e in list(self._entries.items())}
 
     # ---- deploy / swap ----
@@ -191,7 +195,12 @@ class ModelRegistry:
                 if version is None:
                     version = entry.next_version
                 entry.next_version = max(entry.next_version, version + 1)
-            active_v = entry.active.version if entry.active else None
+            # snapshot: promote() swaps entry.active under entry.lock
+            # (not deploy_lock), so a re-read here could null between
+            # the check and the deref (ZL721 pattern, lock-exempt for
+            # the lint but not for the race)
+            _dep0 = entry.active
+            active_v = _dep0.version if _dep0 is not None else None
 
             def fail(stage: str, e: BaseException):
                 raise DeployError(

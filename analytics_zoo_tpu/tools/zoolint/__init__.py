@@ -18,30 +18,55 @@ Rule codes (catalog with rationale: docs/dev/zoolint.md):
                        unbounded queues)
     ZL601              bare print/stdlib logging on the hot path (use
                        the structured logger with request-id fields)
+    ZL701/ZL702        resource balance over the exception-path CFG
+                       (acquire/release pairing, in-flight counter
+                       increments leaked on unwind)
+    ZL711              use-after-donate (reading a buffer after it was
+                       passed at a donate_argnums position)
+    ZL721              check-then-deref of a shared mutable attribute
+                       (re-read instead of a local snapshot)
+    ZL731              lock-order cycles in the global lexical
+                       lock-acquisition graph
+
+v2 rules run real dataflow: :mod:`cfg` builds a per-function CFG with
+explicit exception edges, :mod:`dataflow` iterates forward
+may-analyses over it.  ``--explain ZLxxx`` prints any rule's
+rationale + minimal bad/good pair.
 
 Runtime half (imports jax lazily, on first use):
 
     with zoolint.sanitize(max_compiles=0):
         hot_loop()
+
+    # invariant-snapshot mode: gauge counters + live thread count must
+    # come back level across a quiesced serve window
+    with zoolint.sanitize(max_compiles=0,
+                          invariants=lambda: {"pending": ac.pending}):
+        warmed_serve_window()
 """
 
 from .baseline import (BaselineError, apply_baseline, load_baseline,
                        render_baseline)
+from .catalog import CATALOG, explain
+from .cfg import CFG, build_cfg
+from .dataflow import solve_forward
 from .engine import ALL_CODES, lint_paths
 from .findings import Finding
 from .hotpath import DEFAULT_HOT_ENTRIES
 
-__all__ = ["ALL_CODES", "BaselineError", "DEFAULT_HOT_ENTRIES",
-           "Finding", "RecompileDetected", "SanitizeError",
-           "SanitizeReport", "apply_baseline", "lint_paths",
-           "load_baseline", "render_baseline", "sanitize"]
+__all__ = ["ALL_CODES", "BaselineError", "CATALOG", "CFG",
+           "DEFAULT_HOT_ENTRIES", "Finding", "InvariantLeakDetected",
+           "RecompileDetected", "SanitizeError", "SanitizeReport",
+           "apply_baseline", "build_cfg", "explain", "lint_paths",
+           "load_baseline", "render_baseline", "sanitize",
+           "solve_forward"]
 
 
 def __getattr__(name):
     # sanitize + its error types live behind a lazy import so linting
     # never drags jax into the process
     if name in ("sanitize", "SanitizeError", "RecompileDetected",
-                "SanitizeReport"):
+                "InvariantLeakDetected", "SanitizeReport"):
         import importlib
         mod = importlib.import_module(".sanitizer", __name__)
         return getattr(mod, name)

@@ -1,0 +1,243 @@
+"""The rule catalog behind ``zoolint --explain ZLxxx``.
+
+One entry per rule code: the rationale (WHY the pattern costs), a
+minimal bad/good example pair (kept in sync with the fixtures in
+``tests/zoolint_fixtures/`` — those are the executable versions), and
+the docs anchor.  ``--explain`` is the on-call path: a CI failure
+prints a code, and the fix should be one terminal command away.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+_DOC = "docs/dev/zoolint.md"
+
+_FAMILY_ANCHORS = {
+    "1": "#zl1xx--recompile-hazards",
+    "2": "#zl2xx--tracer-leaks-inside-jit-decorated-scopes",
+    "3": "#zl3xx--host-sync-on-the-serving-hot-path-project-wide",
+    "4": "#zl4xx--lock-discipline",
+    "5": "#zl5xx--thread-lifecycle",
+    "6": "#zl6xx--observability-discipline-hot-path-call-graph-based",
+    "7": "#zl7xx--exception-path-dataflow-rules-v2",
+}
+
+CATALOG: Dict[str, Dict[str, str]] = {
+    "ZL101": {
+        "title": "jax.jit/pmap invoked inside a loop",
+        "rationale": "Each iteration builds a fresh wrapper with an "
+                     "empty trace cache: one compile per iteration, "
+                     "forever.  Hoist the jit out and reuse it.",
+        "bad": "for x in xs:\n    f = jax.jit(step)\n    f(x)",
+        "good": "f = jax.jit(step)\nfor x in xs:\n    f(x)",
+    },
+    "ZL102": {
+        "title": "immediately-invoked jit",
+        "rationale": "`jax.jit(f)(x)` builds a new wrapper per call, "
+                     "so every call re-traces.  Bind once, call many.",
+        "bad": "out = jax.jit(f)(x)",
+        "good": "g = jax.jit(f)\nout = g(x)",
+    },
+    "ZL103": {
+        "title": "unhashable literal in a static jit position",
+        "rationale": "Static jit arguments key the compile cache and "
+                     "must be hashable — a list raises (or churns the "
+                     "cache); a tuple works.",
+        "bad": "g = jax.jit(f, static_argnums=(1,))\ng(x, [4, 4])",
+        "good": "g = jax.jit(f, static_argnums=(1,))\ng(x, (4, 4))",
+    },
+    "ZL201": {
+        "title": "host cast of a traced value inside jit",
+        "rationale": "float()/int()/bool() on a tracer raises "
+                     "TracerConversionError at trace time (or "
+                     "silently constant-folds).  Use lax primitives "
+                     "or hoist the cast out of the jit.",
+        "bad": "@jax.jit\ndef f(x):\n    return float(x) * 2",
+        "good": "@jax.jit\ndef f(x):\n    return x * 2.0",
+    },
+    "ZL202": {
+        "title": "Python branch on a traced value inside jit",
+        "rationale": "Tracers have no truth value — `if x > 0:` fails "
+                     "at trace time.  Use lax.cond/jnp.where, or mark "
+                     "the argument static.  Shape/ndim/len() tests "
+                     "are exempt (static under trace).",
+        "bad": "@jax.jit\ndef f(x):\n    if x > 0:\n        return x",
+        "good": "@jax.jit\ndef f(x):\n    return jnp.where(x > 0, x, 0)",
+    },
+    "ZL203": {
+        "title": "host materialization of a traced value inside jit",
+        "rationale": "np.asarray/.item()/.tolist() force a host "
+                     "round-trip inside the trace.  Keep the math in "
+                     "jnp until the caller fetches explicitly.",
+        "bad": "@jax.jit\ndef f(x):\n    return np.asarray(x).sum()",
+        "good": "@jax.jit\ndef f(x):\n    return jnp.sum(x)",
+    },
+    "ZL301": {
+        "title": "block_until_ready on the serving hot path",
+        "rationale": "A forced device sync serializes dispatch "
+                     "against compute — the exact overlap the "
+                     "coalescer pipeline exists to create.  Fetch at "
+                     "the fan-out point via jax.device_get; baseline "
+                     "with a justification when the sync IS the "
+                     "point (compile-time measurement).",
+        "bad": "def predict(self, x):\n"
+               "    return jax.block_until_ready(self._fn(x))",
+        "good": "def predict(self, x):\n"
+                "    return jax.device_get(self._fn(x))",
+    },
+    "ZL302": {
+        "title": "implicit device->host materialization on the hot path",
+        "rationale": "np.asarray()/float() wrapped straight around a "
+                     "dispatch makes the transfer invisible to "
+                     "transfer guards and readers.  Fetch via "
+                     "jax.device_get.",
+        "bad": "rows = np.asarray(self.dispatch_padded(batch))",
+        "good": "rows = np.asarray(jax.device_get(\n"
+                "    self.dispatch_padded(batch)))",
+    },
+    "ZL401": {
+        "title": "attribute written with AND without its owning lock",
+        "rationale": "The lock held at the majority of write sites is "
+                     "the owner; a site missing it is a torn/lost "
+                     "update one preemption away.  __init__ writes "
+                     "are exempt (no concurrent reader exists yet).",
+        "bad": "with self._lock:\n    self.n += 1\n...\nself.n = 0",
+        "good": "with self._lock:\n    self.n += 1\n...\n"
+                "with self._lock:\n    self.n = 0",
+    },
+    "ZL402": {
+        "title": "blocking device work under a held lock",
+        "rationale": "warmup/block_until_ready/predict under a lock "
+                     "makes every thread contending that lock wait on "
+                     "device latency.  Move the dispatch outside the "
+                     "critical section.",
+        "bad": "with self._lock:\n    out = self._model.predict(x)",
+        "good": "with self._lock:\n    model = self._model\n"
+                "out = model.predict(x)",
+    },
+    "ZL501": {
+        "title": "non-daemon thread never joined",
+        "rationale": "It outlives its owner, pins interpreter exit, "
+                     "and strands work on crash.  Pass daemon=True or "
+                     "join it in this module.",
+        "bad": "threading.Thread(target=loop).start()",
+        "good": "threading.Thread(target=loop, daemon=True).start()",
+    },
+    "ZL502": {
+        "title": "unbounded queue.Queue",
+        "rationale": "Under overload an unbounded queue converts "
+                     "memory into latency instead of shedding — "
+                     "request N succeeds seconds too late.  Pass "
+                     "maxsize (see serving/admission.py).",
+        "bad": "self._q = queue.Queue()",
+        "good": "self._q = queue.Queue(maxsize=1024)",
+    },
+    "ZL601": {
+        "title": "print/stdlib logging on the serving hot path",
+        "rationale": "Free-text output cannot be joined back to the "
+                     "request that produced it, and print takes a "
+                     "global I/O lock mid-dispatch.  Use the "
+                     "structured logger (observability.log."
+                     "get_logger) — its records carry the request id.",
+        "bad": "def predict(self, x):\n    print('serving', x.shape)",
+        "good": "_slog = get_logger('zoo.serve')\n"
+                "def predict(self, x):\n"
+                "    _slog.info('serving', shape=x.shape)",
+    },
+    "ZL701": {
+        "title": "acquire() not released on an exception path",
+        "rationale": "A resource acquired with recv.acquire() must be "
+                     "released on EVERY path out of the function, "
+                     "including the unwind: an exception escaping "
+                     "between acquire and release leaks the slot "
+                     "forever (the caller cannot know it was taken).  "
+                     "Returning while holding is allowed — that is "
+                     "ownership transfer, and the caller can see it.",
+        "bad": "self._sem.acquire()\ntry:\n    return work()\n"
+               "finally:\n    pass  # release deleted -> leak",
+        "good": "self._sem.acquire()\ntry:\n    return work()\n"
+                "finally:\n    self._sem.release()",
+    },
+    "ZL702": {
+        "title": "counter increment not balanced on an exception path",
+        "rationale": "A tracked counter (one the module both += and "
+                     "-= somewhere: in-flight counts, queue seats, "
+                     "slot occupancy) incremented and then leaked on "
+                     "an exception exit shrinks capacity one "
+                     "exception at a time — the PR 6 _acquire "
+                     "KeyboardInterrupt seat leak.  Balance it in an "
+                     "except-BaseException unwind before re-raising "
+                     "(or hand it to a helper that decrements it).",
+        "bad": "self._waiting += 1\nwhile not ready():\n"
+               "    if lapsed():\n        raise Timeout()  # seat leaks",
+        "good": "self._waiting += 1\ntry:\n    while not ready():\n"
+                "        if lapsed():\n            raise Timeout()\n"
+                "except BaseException:\n    self._waiting -= 1\n"
+                "    raise",
+    },
+    "ZL711": {
+        "title": "use after donate",
+        "rationale": "An array passed at a donate_argnums position "
+                     "belongs to XLA after the call — its buffer may "
+                     "already BE the output.  Reading it is at best "
+                     "`Array has been deleted`, at worst silent "
+                     "garbage.  Rebind the donated state from the "
+                     "call's result in the same statement (the "
+                     "DecodeEngine slot-array protocol).",
+        "bad": "step = jax.jit(f, donate_argnums=(0,))\n"
+               "out = step(caches, tok)\nx = caches[0]  # poisoned",
+        "good": "step = jax.jit(f, donate_argnums=(0,))\n"
+                "caches, tok = step(caches, tok)",
+    },
+    "ZL721": {
+        "title": "check-then-deref of a shared attribute",
+        "rationale": "A None/truthiness check on a shared mutable "
+                     "attribute followed by a RE-READ of the same "
+                     "attribute races every concurrent writer: the "
+                     "attribute can be nulled between the check and "
+                     "the deref.  Snapshot into a local and check "
+                     "THAT (autoscaler_for reading entry.active "
+                     "twice was this bug).",
+        "bad": "if entry.active is not None:\n"
+               "    return entry.active.version  # may be None now",
+        "good": "dep = entry.active\nif dep is not None:\n"
+                "    return dep.version",
+    },
+    "ZL731": {
+        "title": "lock-order cycle",
+        "rationale": "Two locks acquired in opposite orders at "
+                     "different sites deadlock the first time two "
+                     "threads interleave the acquisitions under "
+                     "load.  Pick one global order (or merge the "
+                     "locks).  RLock self-re-entry is exempt.",
+        "bad": "def a(self):\n    with self._lock:\n"
+               "        with self._cond: ...\n"
+               "def b(self):\n    with self._cond:\n"
+               "        with self._lock: ...",
+        "good": "def a(self):\n    with self._lock:\n"
+                "        with self._cond: ...\n"
+                "def b(self):\n    with self._lock:\n"
+                "        with self._cond: ...",
+    },
+}
+
+
+def anchor_for(code: str) -> str:
+    """The docs anchor of a rule code (family-level sections)."""
+    digit = code[2] if len(code) > 2 else ""
+    return _DOC + _FAMILY_ANCHORS.get(digit, "")
+
+
+def explain(code: str) -> Optional[str]:
+    """The --explain rendering for one code, None when unknown."""
+    entry = CATALOG.get(code)
+    if entry is None:
+        return None
+    bad = "\n".join("    " + l for l in entry["bad"].splitlines())
+    good = "\n".join("    " + l for l in entry["good"].splitlines())
+    return (f"{code} — {entry['title']}\n\n"
+            f"{entry['rationale']}\n\n"
+            f"bad:\n{bad}\n\n"
+            f"good:\n{good}\n\n"
+            f"docs: {anchor_for(code)}")

@@ -1,22 +1,35 @@
 """zoolint command line.
 
     python -m analytics_zoo_tpu.tools.zoolint PATH... [--baseline FILE]
+    python -m analytics_zoo_tpu.tools.zoolint --explain ZL701
 
-Exit codes: 0 clean (modulo baseline), 2 new findings, 3 the baseline
-file itself is broken (bad JSON / empty justification).
+Exit-code contract (test-pinned in tests/test_zoolint.py):
+
+    0  clean (modulo baseline), or --explain of a known code
+    2  usage — bad arguments, unknown --explain code, a broken
+       baseline file (bad JSON / empty justification)
+    3  findings — new findings not covered by the baseline
+
+``--format json`` emits a machine-readable payload (findings,
+suppressed, stale suppressions, a per-code summary) for CI —
+``scripts/lint.sh`` consumes it to print its per-code summary line.
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import sys
 from typing import List, Optional
 
 from .baseline import (BaselineError, apply_baseline, load_baseline,
                        render_baseline)
+from .catalog import explain
 from .engine import lint_paths
 from .hotpath import DEFAULT_HOT_ENTRIES
+
+EXIT_CLEAN, EXIT_USAGE, EXIT_FINDINGS = 0, 2, 3
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -24,7 +37,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="zoolint",
         description="JAX-aware static analyzer for the serving/training "
                     "stack (rule catalog: docs/dev/zoolint.md)")
-    ap.add_argument("paths", nargs="+", help="files or trees to lint")
+    ap.add_argument("paths", nargs="*", help="files or trees to lint")
+    ap.add_argument("--explain", metavar="ZLxxx", default=None,
+                    help="print one rule's rationale, a minimal "
+                         "bad/good example, and its docs anchor, "
+                         "then exit (0 known / 2 unknown)")
     ap.add_argument("--baseline", default=None,
                     help="JSON baseline of accepted findings")
     ap.add_argument("--update-baseline", action="store_true",
@@ -38,6 +55,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "hot-path entry points (ZL301/ZL302)")
     args = ap.parse_args(argv)
 
+    if args.explain is not None:
+        text = explain(args.explain.upper())
+        if text is None:
+            print(f"zoolint: unknown rule code {args.explain!r} "
+                  "(see docs/dev/zoolint.md for the catalog)",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        print(text)
+        return EXIT_CLEAN
+
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("zoolint: error: paths required (or --explain ZLxxx)",
+              file=sys.stderr)
+        return EXIT_USAGE
+
     entries = tuple(e for e in args.hot_entries.split(",") if e)
     findings = lint_paths(args.paths, root=args.root, hot_entries=entries)
 
@@ -47,7 +80,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             f.write(render_baseline(findings))
         print(f"zoolint: wrote {len(findings)} finding(s) to {target} — "
               "fill in every justification before committing")
-        return 0
+        return EXIT_CLEAN
 
     suppressed, stale = [], []
     if args.baseline:
@@ -55,14 +88,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             baseline = load_baseline(args.baseline)
         except BaselineError as e:
             print(f"zoolint: {e}", file=sys.stderr)
-            return 3
+            return EXIT_USAGE
         findings, suppressed, stale = apply_baseline(findings, baseline)
 
+    rc = EXIT_FINDINGS if findings else EXIT_CLEAN
     if args.format == "json":
+        by_code = collections.Counter(f.code for f in findings)
         print(json.dumps({
-            "findings": [vars(f) for f in findings],
+            "findings": [f.to_dict() for f in findings],
             "suppressed": [vars(f) for f in suppressed],
-            "stale_suppressions": stale}, indent=2))
+            "stale_suppressions": stale,
+            "summary": {"total": len(findings),
+                        "by_code": dict(sorted(by_code.items())),
+                        "suppressed": len(suppressed),
+                        "stale": len(stale)},
+            "exit": rc}, indent=2))
     else:
         for f in findings:
             print(f.render())
@@ -73,7 +113,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         summary = (f"zoolint: {len(findings)} new finding(s), "
                    f"{len(suppressed)} baselined, {len(stale)} stale")
         print(summary, file=sys.stderr)
-    return 2 if findings else 0
+    return rc
 
 
 if __name__ == "__main__":

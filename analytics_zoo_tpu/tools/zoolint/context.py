@@ -187,6 +187,75 @@ def is_lock_ctor(ctx: ModuleContext, node: ast.AST) -> bool:
         len(parts) == 1 or parts[0] == "threading")
 
 
+def iter_function_defs(ctx: "ModuleContext"):
+    """Every (qualname, funcdef) in the module, nested defs included —
+    the iteration order the CFG-based rules analyze functions in."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    class V(QualnameVisitor):
+        def _visit_func(self, node):
+            self.func_stack.append(node.name)
+            out.append((self.qualname, node))
+            self.generic_visit(node)
+            self.func_stack.pop()
+
+        visit_FunctionDef = _visit_func
+        visit_AsyncFunctionDef = _visit_func
+
+    V(ctx).visit(ctx.tree)
+    return out
+
+
+def header_parts(st: ast.stmt) -> List[ast.AST]:
+    """The sub-expressions a CFG node actually EVALUATES — compound
+    statements' bodies are separate CFG nodes, so a rule scanning a
+    node must look only at its header (an ``if``'s test, a ``for``'s
+    iterator, a ``with``'s context expressions), never the body."""
+    if isinstance(st, (ast.If, ast.While)):
+        return [st.test]
+    if isinstance(st, (ast.For, ast.AsyncFor)):
+        return [st.target, st.iter]
+    if isinstance(st, (ast.With, ast.AsyncWith)):
+        parts: List[ast.AST] = []
+        for item in st.items:
+            parts.append(item.context_expr)
+            if item.optional_vars is not None:
+                parts.append(item.optional_vars)
+        return parts
+    if isinstance(st, (ast.Try, ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+        return []
+    return [st]
+
+
+def binding_targets(st: ast.stmt) -> List[ast.AST]:
+    """Every individual binding target a statement rebinds — Assign
+    (tuple/list/starred targets flattened, nested included), AnnAssign,
+    ``for`` targets, ``del`` — shared by the v2 rules so "what does
+    this statement rebind?" has exactly one answer.  AugAssign is
+    deliberately NOT included: ``x += 1`` reads-modifies-writes, and
+    the resource rules treat it as its own gen/kill event."""
+    roots: List[ast.AST] = []
+    if isinstance(st, ast.Assign):
+        roots.extend(st.targets)
+    elif isinstance(st, ast.AnnAssign):
+        roots.append(st.target)
+    elif isinstance(st, (ast.For, ast.AsyncFor)):
+        roots.append(st.target)
+    elif isinstance(st, ast.Delete):
+        roots.extend(st.targets)
+    out: List[ast.AST] = []
+    while roots:
+        t = roots.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            roots.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            roots.append(t.value)
+        else:
+            out.append(t)
+    return out
+
+
 def is_static_expr(node: ast.AST) -> bool:
     """True when an expression is host-static even if its leaves are
     traced: ``x.shape``, ``x.ndim == 2``, ``len(x)``,

@@ -14,11 +14,15 @@ from .findings import Finding
 from .hotpath import (DEFAULT_HOT_ENTRIES, collect_hot_defs,
                       rule_hot_logging, rule_hot_path)
 from .rules_concurrency import (rule_blocking_under_lock,
+                                rule_check_then_deref,
                                 rule_lock_discipline,
+                                rule_lock_order,
                                 rule_thread_lifecycle,
                                 rule_unbounded_queue)
+from .rules_donation import rule_use_after_donate
 from .rules_jax import rule_recompile, rule_tracer_leaks, \
     rule_unhashable_static
+from .rules_resource import rule_resource_balance
 
 MODULE_RULES: Tuple[Callable[[ModuleContext], List[Finding]], ...] = (
     rule_recompile,          # ZL101 ZL102
@@ -28,12 +32,14 @@ MODULE_RULES: Tuple[Callable[[ModuleContext], List[Finding]], ...] = (
     rule_blocking_under_lock,  # ZL402
     rule_thread_lifecycle,   # ZL501
     rule_unbounded_queue,    # ZL502
+    rule_resource_balance,   # ZL701 ZL702 (exception-path CFG)
+    rule_use_after_donate,   # ZL711 (exception-path CFG)
 )
 
 #: every rule code zoolint can emit (docs + fixture tests key off this)
 ALL_CODES = ("ZL101", "ZL102", "ZL103", "ZL201", "ZL202", "ZL203",
              "ZL301", "ZL302", "ZL401", "ZL402", "ZL501", "ZL502",
-             "ZL601")
+             "ZL601", "ZL701", "ZL702", "ZL711", "ZL721", "ZL731")
 
 
 def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
@@ -79,5 +85,9 @@ def lint_paths(paths: Sequence[str], root: Optional[str] = None,
     findings.extend(rule_hot_path(ctxs, hot_entries, hot_defs=hot_defs))
     findings.extend(rule_hot_logging(ctxs, hot_entries,
                                      hot_defs=hot_defs))
+    # project-wide v2 passes: shared-attr check-then-deref and the
+    # global lock-acquisition graph both need every module at once
+    findings.extend(rule_check_then_deref(ctxs))
+    findings.extend(rule_lock_order(ctxs))
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings

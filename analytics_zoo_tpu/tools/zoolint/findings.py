@@ -26,6 +26,17 @@ class Finding:
         """The baseline-matching fingerprint."""
         return (self.code, self.path, self.symbol)
 
+    @property
+    def docs(self) -> str:
+        """The rule-catalog docs anchor for this finding's code."""
+        from .catalog import anchor_for
+        return anchor_for(self.code)
+
+    def to_dict(self) -> dict:
+        """The --format json shape: the dataclass fields plus the
+        docs anchor (CI links findings straight to the catalog)."""
+        return {**dataclasses.asdict(self), "docs": self.docs}
+
     def render(self) -> str:
         return (f"{self.path}:{self.line}:{self.col}: {self.code} "
                 f"[{self.symbol}] {self.message}")

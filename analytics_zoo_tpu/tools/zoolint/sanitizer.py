@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
-from typing import List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 class SanitizeError(RuntimeError):
@@ -46,6 +46,15 @@ class SanitizeError(RuntimeError):
 
 class RecompileDetected(SanitizeError):
     """The sanitized block compiled more than its budget allows."""
+
+
+class InvariantLeakDetected(SanitizeError):
+    """A gauge invariant moved across the sanitized block: an
+    in-flight/slot/ticket counter (or the live thread count) did not
+    return to its entry value over a quiesced serve window — the
+    runtime signature of the ZL701/ZL702 leak class (a seat taken on
+    an exception path and never given back shows up here as a counter
+    permanently up by one)."""
 
 
 class SanitizeReport:
@@ -81,7 +90,9 @@ _COMPILE_EVENT_SUBSTR = "backend_compile"
 @contextlib.contextmanager
 def sanitize(max_compiles: int = 0,
              transfer_guard: Optional[str] = "disallow",
-             label: str = "zoolint.sanitize"):
+             label: str = "zoolint.sanitize",
+             invariants: Optional[Callable[[], Dict[str, Any]]] = None,
+             invariant_threads: bool = True):
     """Assert the block stays compile- and transfer-clean (module doc).
 
     ``max_compiles``: XLA compiles the block may perform (0 for a warmed
@@ -91,6 +102,21 @@ def sanitize(max_compiles: int = 0,
     when the budget is exceeded.  Transfer violations raise inside jax
     at the offending call (XlaRuntimeError, "Disallowed ... transfer").
 
+    **Invariant-snapshot mode** (``invariants=``): pass a zero-arg
+    callable returning gauge values — in-flight counts, queue seats,
+    slot occupancy, admission tickets — and the block asserts every
+    one of them (plus, with ``invariant_threads``, the live
+    ``threading.active_count()``) returns to its entry value by block
+    exit, raising :class:`InvariantLeakDetected` otherwise.  The block
+    must be QUIESCED at both ends (warmed before entry, drained before
+    exit — a sequential closed-loop serve window is, by construction);
+    a monotonic stat counter does not belong in the snapshot, only
+    gauges that a leak-free window brings back to rest.  This is the
+    runtime twin of the ZL701/ZL702 static rules: the lint proves no
+    exception path CAN leak a seat, the snapshot proves this run
+    DIDN'T.  Checked only on clean exit (an exception unwinding out of
+    the block is its own report), after the compile budget.
+
     Guards are process-global while the block runs — don't nest, and
     don't run unrelated jax work concurrently with a sanitized block.
     """
@@ -98,6 +124,11 @@ def sanitize(max_compiles: int = 0,
     from jax._src import monitoring as _monitoring
 
     report = SanitizeReport(label)
+    pre_inv: Optional[Dict[str, Any]] = None
+    if invariants is not None:
+        pre_inv = dict(invariants())
+        if invariant_threads:
+            pre_inv["live_threads"] = threading.active_count()
     active = [True]  # unhook even if jax keeps the listener registered
 
     def _listener(key: str, duration: float, **kw):
@@ -132,3 +163,19 @@ def sanitize(max_compiles: int = 0,
             f"budgeted for {max_compiles} — a shape/dtype escaped the "
             f"warmed bucket ladder, or a jit wrapper was rebuilt:\n  "
             f"{lines}")
+    if pre_inv is not None:
+        post_inv = dict(invariants())
+        if invariant_threads:
+            post_inv["live_threads"] = threading.active_count()
+        leaks = {k: (pre_inv.get(k), post_inv.get(k))
+                 for k in sorted(set(pre_inv) | set(post_inv))
+                 if pre_inv.get(k) != post_inv.get(k)}
+        if leaks:
+            detail = ", ".join(f"{k}: {a!r} -> {b!r}"
+                               for k, (a, b) in leaks.items())
+            raise InvariantLeakDetected(
+                f"{label}: {len(leaks)} invariant(s) moved across a "
+                f"quiesced serve window ({detail}) — an in-flight/"
+                "slot/ticket counter (or a thread) leaked; an "
+                "exception path somewhere took a seat it never gave "
+                "back (the ZL701/ZL702 bug class, live)")

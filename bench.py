@@ -1937,12 +1937,29 @@ def serving_bench(n_requests: int = 400, d_in: int = 64, d_hidden: int = 64,
         # and transfer-clean (implicit host<->device transfers abort the
         # dispatch under the guard; any XLA compile fails the block).
         # Runs over BOTH paths: coalesced (dispatcher thread — covered
-        # because the guard is process-global) and solo.
-        from analytics_zoo_tpu.tools.zoolint import (RecompileDetected,
-                                                     sanitize)
-        san = {"clean": False, "compiles": None, "error": None}
+        # because the guard is process-global) and solo.  The
+        # invariant-snapshot mode additionally pins the leak class the
+        # ZL701/702 static rules cover: in-flight/pending gauges and
+        # the live thread count must come back LEVEL across this
+        # quiesced window (warmed before, drained after — every
+        # predict below returns before the block exits).
+        from analytics_zoo_tpu.tools.zoolint import (
+            InvariantLeakDetected, RecompileDetected, sanitize)
+
+        def _serving_invariants():
+            # coalesced path only: the solo InferenceModel exposes no
+            # in-flight gauge (its 'coalescer_pending' is a constant 0
+            # — snapshotting it would claim a check that cannot fire);
+            # the solo path is still covered by the thread-count leg
+            # and the guard/compile checks
+            cs = coal_im.serving_stats()
+            return {"coalescer_pending": cs.get("coalescer_pending", 0)}
+
+        san = {"clean": False, "compiles": None, "error": None,
+               "invariants": None}
         try:
-            with sanitize(max_compiles=0) as rep:
+            with sanitize(max_compiles=0,
+                          invariants=_serving_invariants) as rep:
                 for k in range(32):
                     coal_im.predict(requests[k % len(requests)])
                     solo_im.predict(requests[k % len(requests)])
@@ -1962,10 +1979,18 @@ def serving_bench(n_requests: int = 400, d_in: int = 64, d_hidden: int = 64,
                 [t.join() for t in ths]
                 if errs:
                     raise RuntimeError(errs[0])
-            san.update(clean=True, compiles=rep.compiles)
+            san.update(clean=True, compiles=rep.compiles,
+                       invariants="ok")
             _log("serving selfcheck: sanitize clean — 0 recompiles, "
                  "0 implicit transfers on the warmed hot loop "
                  "(transfer_guard=disallow)")
+            _log("serving selfcheck: invariant snapshot OK — "
+                 "coalescer pending gauge and live thread count "
+                 "level across the quiesced serve window")
+        except InvariantLeakDetected as e:
+            san["error"] = f"invariant leak: {e}"
+            _log(f"serving selfcheck FAIL: invariant snapshot — {e}")
+            ok = False
         except RecompileDetected as e:
             san["error"] = f"recompile: {e}"
             _log(f"serving selfcheck FAIL: sanitize caught a recompile "
@@ -2364,6 +2389,32 @@ def _lt_autoscale(np, quick: bool, selfcheck: bool, collectors,
             ok = False
         if outcomes.get("error"):
             _log(f"loadtest FAIL: {outcomes['error']} request errors")
+            ok = False
+        # ---- invariant snapshot over a quiesced serve window: after
+        # the whole spike/drain cycle the admission gauges must be at
+        # rest, stay leak-free across a short sequential window, and
+        # no thread may have leaked — the runtime twin of the
+        # ZL701/702 exception-path rules, run where smoke can grep it
+        from analytics_zoo_tpu.tools.zoolint import sanitize
+        ac = entry.admission
+
+        def _lt_invariants():
+            snap = ac.snapshot()
+            return {"queue_depth": snap["queue_depth"],
+                    "running": snap["running"]}
+
+        try:
+            with sanitize(max_compiles=0, invariants=_lt_invariants):
+                for _ in range(16):
+                    reg.predict("elastic", x)
+            res["invariants"] = "ok"
+            print("LOADTEST_INVARIANTS_OK window=16", flush=True)
+        except Exception as e:  # noqa: BLE001 — any violation
+            # (InvariantLeakDetected, a recompile, a transfer guard
+            # abort) fails the gate identically
+            res["invariants"] = f"{type(e).__name__}: {e}"
+            _log(f"loadtest FAIL: invariant snapshot over a quiesced "
+                 f"window: {type(e).__name__}: {e}")
             ok = False
     for e in events:
         _log(f"LOADTEST_AUTOSCALE_EVENT {e['direction']} "

@@ -3,15 +3,52 @@
 # against the checked-in baseline of justified suppressions.
 #
 # Exit 0  = clean modulo zoolint_baseline.json
-# Exit 2  = NEW finding (fix it, or baseline it WITH a justification —
-#           see docs/dev/zoolint.md for the workflow)
-# Exit 3  = the baseline file itself is broken (bad JSON / empty
-#           justification)
+# Exit 2  = usage — bad arguments or a broken baseline file (bad JSON /
+#           empty justification)
+# Exit 3  = findings (fix them, or baseline WITH a justification — see
+#           docs/dev/zoolint.md for the workflow)
+#
+# The analyzer runs in --format json and this script renders each
+# finding plus the per-code summary line CI logs key off.
 #
 # Pure AST — runs in seconds; importing the package pulls jax, so pin
 # the platform to cpu like every other CI gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-env JAX_PLATFORMS=cpu python -m analytics_zoo_tpu.tools.zoolint \
-    analytics_zoo_tpu --baseline zoolint_baseline.json "$@"
+out=$(env JAX_PLATFORMS=cpu python -m analytics_zoo_tpu.tools.zoolint \
+    analytics_zoo_tpu --baseline zoolint_baseline.json \
+    --format json "$@") && rc=0 || rc=$?
+if [ "$rc" -ne 0 ] && [ "$rc" -ne 3 ]; then
+    # usage / broken baseline: the error already went to stderr and
+    # stdout is not a JSON payload — don't try to summarize it
+    [ -n "$out" ] && printf '%s\n' "$out"
+    exit "$rc"
+fi
+case "$out" in
+    "{"*) ;;
+    *)
+        # non-JSON success output: forwarded modes like
+        # --update-baseline or --explain print plain text — pass it
+        # through untouched instead of feeding it to the summarizer
+        printf '%s\n' "$out"
+        exit "$rc"
+        ;;
+esac
+ZOOLINT_JSON="$out" python - <<'PY'
+import json
+import os
+
+data = json.loads(os.environ["ZOOLINT_JSON"])
+for f in data["findings"]:
+    print("{path}:{line}:{col}: {code} [{symbol}] {message}"
+          .format(**f))
+s = data["summary"]
+by = " ".join(f"{c}={n}" for c, n in sorted(s["by_code"].items())) \
+    or "none"
+print(f"zoolint summary: total={s['total']} "
+      f"suppressed={s['suppressed']} stale={s['stale']} by_code: {by}")
+PY
+if [ "$rc" -ne 0 ]; then
+    exit "$rc"
+fi
 echo "zoolint OK"

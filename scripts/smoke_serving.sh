@@ -54,6 +54,15 @@ grep -q "LOADTEST_SCRAPE_OK" <<<"$lt" || {
     echo "smoke FAIL: loadtest scrape of the elastic families failed" >&2
     exit 1
 }
+# zoolint v2 runtime half: the invariant-snapshot sanitizer must have
+# run over a quiesced post-drain serve window and found every
+# in-flight/slot/ticket gauge (and the thread count) back at rest —
+# the runtime twin of the ZL701/702 exception-path leak rules
+grep -q "LOADTEST_INVARIANTS_OK" <<<"$lt" || {
+    echo "smoke FAIL: loadtest never ran (or failed) the zoolint" \
+         "invariant-snapshot check on the quiesced serve window" >&2
+    exit 1
+}
 grep -q "LOADTEST_SELFCHECK_OK" <<<"$lt" || {
     echo "smoke FAIL: loadtest selfcheck gates failed" >&2
     exit 1

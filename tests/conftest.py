@@ -97,9 +97,13 @@ def rng():
 def zoolint_sanitize():
     """The zoolint runtime sanitizer: wrap a pinned hot loop and assert
     zero unexpected XLA compiles + no implicit host<->device transfers
-    (docs/dev/zoolint.md §Sanitizer).  Guards are process-global while
-    the block runs, so don't use it around concurrent unrelated jax
-    work — fine under the sequential tier-1 runner."""
+    (docs/dev/zoolint.md §Sanitizer).  Pass ``invariants=`` (a zero-arg
+    callable returning gauge values) for the invariant-snapshot mode:
+    in-flight/slot/ticket counters and the live thread count must come
+    back level across the quiesced block, else
+    ``InvariantLeakDetected``.  Guards are process-global while the
+    block runs, so don't use it around concurrent unrelated jax work —
+    fine under the sequential tier-1 runner."""
     from analytics_zoo_tpu.tools.zoolint import sanitize
     return sanitize
 

@@ -81,7 +81,7 @@ def test_positive_fixture_in_package_fails_cli(tmp_path):
         shutil.copy(pos, pkg / os.path.basename(pos))
     rc = zoolint_main([str(pkg), "--baseline", BASELINE,
                        "--root", str(tmp_path)])
-    assert rc == 2
+    assert rc == 3  # findings exit (0 clean / 2 usage / 3 findings)
     # and the findings cover EVERY rule code — no rule is gate-dead
     found = {f.code for f in lint_paths([str(pkg)], root=str(tmp_path))}
     assert found == set(ALL_CODES), \
@@ -108,7 +108,7 @@ def test_baseline_rejects_empty_justification(tmp_path):
         load_baseline(str(bad))
     rc = zoolint_main([_fixture("ZL101", "pos"),
                        "--baseline", str(bad)])
-    assert rc == 3  # a broken baseline is its own failure, loudly
+    assert rc == 2  # a broken baseline is a usage failure, loudly
 
 
 def test_baseline_suppresses_on_symbol_not_line(tmp_path):

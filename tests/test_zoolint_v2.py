@@ -1,0 +1,447 @@
+"""zoolint v2: exception-path dataflow rules, the --explain/--format
+CLI surface, the invariant-snapshot sanitizer, and the fixes the new
+rules pinned in serving/.
+
+The seeded-mutation tests are the acceptance bar made executable:
+deleting the release on an exception path of the good fixture MUST
+light ZL701; reverting the PR 6 ``_acquire`` unwind fix (on a faithful
+copy of its shape) MUST light ZL702; re-reading ``entry.active`` after
+a None check (the ``autoscaler_for`` bug shape) MUST light ZL721.
+"""
+
+import json
+import os
+import subprocess
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.tools.zoolint import (ALL_CODES, CATALOG,
+                                             explain, lint_paths)
+from analytics_zoo_tpu.tools.zoolint.cli import main as zoolint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "zoolint_fixtures")
+V2_CODES = ("ZL701", "ZL702", "ZL711", "ZL721", "ZL731")
+
+
+def _lint_src(tmp_path, src: str):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(src))
+    return lint_paths([str(p)], root=str(tmp_path))
+
+
+# ------------------------------------------------- seeded mutations
+def test_deleting_release_on_exception_path_is_caught(tmp_path):
+    """The ZL701 acceptance gate: take the GOOD fixture, delete its
+    release, and the exception path must light up."""
+    good = open(os.path.join(FIXTURES, "zl701_neg.py")).read()
+    assert not lint_paths([os.path.join(FIXTURES, "zl701_neg.py")],
+                          root=REPO)
+    broken = good.replace(
+        "self._sem.release()  # every exit path, unwind included",
+        "pass")
+    assert broken != good
+    codes = [f.code for f in _lint_src(tmp_path, broken)]
+    assert "ZL701" in codes
+
+
+def test_reverting_pr6_acquire_unwind_fix_is_caught(tmp_path):
+    """The ZL702 acceptance gate on a faithful copy of _acquire's
+    shape: seat taken under the condition, a wait loop that can raise
+    (deadline lapse / KeyboardInterrupt inside Condition.wait), the
+    except-BaseException unwind returning the seat.  With the unwind:
+    clean.  Reverted (the pre-PR 6 shape): ZL702."""
+    fixed = open(os.path.join(FIXTURES, "zl702_neg.py")).read()
+    reverted = open(os.path.join(FIXTURES, "zl702_pos.py")).read()
+    assert "except BaseException" in fixed
+    assert "except BaseException" not in reverted
+    assert not _lint_src(tmp_path, fixed)
+    findings = _lint_src(tmp_path, reverted)
+    assert [f.code for f in findings] == ["ZL702"]
+    assert "_waiting" in findings[0].message
+
+
+def test_entry_active_reread_after_none_check_is_caught(tmp_path):
+    """The ZL721 acceptance gate, in the autoscaler_for get_signals
+    shape the PR 6 review caught by hand."""
+    src = """\
+        import threading
+
+
+        class Entry:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.active = None
+
+            def swap(self, dep):
+                with self.lock:
+                    self.active = dep
+
+
+        def get_signals(entry):
+            if entry.active is not None:
+                return {"active": entry.active.model.active_replicas}
+            return {"active": None}
+        """
+    src = textwrap.dedent(src)
+    findings = _lint_src(tmp_path, src)
+    assert [f.code for f in findings] == ["ZL721"]
+    # and the single-read snapshot form is the sanctioned fix
+    fixed = src.replace(
+        "    if entry.active is not None:\n"
+        "        return {\"active\": entry.active.model"
+        ".active_replicas}\n"
+        "    return {\"active\": None}",
+        "    dep = entry.active\n"
+        "    if dep is not None:\n"
+        "        return {\"active\": dep.model.active_replicas}\n"
+        "    return {\"active\": None}")
+    assert fixed != src
+    assert not _lint_src(tmp_path, fixed)
+
+
+def test_decode_engine_slot_protocol_pins_clean_for_zl711():
+    """The DecodeEngine rebinds its donated slot arrays from every
+    plan call's result — ZL711 must see the protocol as safe (and the
+    package gate keeps it that way)."""
+    path = os.path.join(REPO, "analytics_zoo_tpu", "pipeline",
+                        "inference", "decode.py")
+    findings = [f for f in lint_paths([path], root=REPO)
+                if f.code == "ZL711"]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_module_level_donor_binding_is_recognized(tmp_path):
+    """The catalog's own bad example at module scope: a top-level
+    jit-donate binding poisons arguments in every function that calls
+    it."""
+    src = """\
+        import jax
+
+
+        def f(caches, tok):
+            return caches, tok
+
+
+        step = jax.jit(f, donate_argnums=(0,))
+
+
+        def drive(caches, toks):
+            for t in toks:
+                out = step(caches, t)  # re-passes the donated buffer
+            return out
+        """
+    findings = _lint_src(tmp_path, src)
+    assert [f.code for f in findings] == ["ZL711"]
+    fixed = src.replace("out = step(caches, t)",
+                        "caches, t2 = step(caches, t)")
+    assert not _lint_src(tmp_path, fixed)
+
+
+def test_donation_threads_through_aot_plan_wrappers(tmp_path):
+    """The decode engine's AOT shape: the donating jit is threaded
+    through a _plan()-style wrapper and bound into a plan table —
+    calls through the table must still poison the donated state."""
+    src = """\
+        import jax
+
+
+        class Engine:
+            def _plan(self, name, jitted, specs):
+                return jitted.lower(*specs).compile()
+
+            def _build_admit(self, b):
+                def admit(caches, prompt):
+                    return caches, prompt
+                return jax.jit(admit, donate_argnums=(0,))
+
+            def _ensure(self, b, specs):
+                self._admit_fns[b] = self._plan(
+                    "admit", self._build_admit(b), specs)
+
+            def bad_admit(self, b, prompt):
+                fn = self._admit_fns[b]
+                out = fn(self._caches, prompt)
+                return self._caches  # donated, never rebound
+
+            def good_admit(self, b, prompt):
+                fn = self._admit_fns[b]
+                self._caches, out = fn(self._caches, prompt)
+                return self._caches
+        """
+    findings = _lint_src(tmp_path, src)
+    assert [f.code for f in findings] == ["ZL711"]
+    assert findings[0].symbol == "Engine.bad_admit"
+
+
+def test_admission_acquire_pins_clean_for_resource_rules():
+    """The PR 6 unwind fix (plus the _grant_locked seat handoff) keeps
+    the real _acquire balanced on every exception path."""
+    path = os.path.join(REPO, "analytics_zoo_tpu", "serving",
+                        "admission.py")
+    findings = [f for f in lint_paths([path], root=REPO)
+                if f.code in ("ZL701", "ZL702")]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_guard_idiom_in_and_chain_is_not_a_reread(tmp_path):
+    """`if flag and x.attr is not None: ...` (no re-read anywhere) is
+    the SAFE idiom — the candidate must not match its own check."""
+    src = """\
+        import threading
+
+
+        class Entry:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.active = None
+
+            def swap(self, dep):
+                with self.lock:
+                    self.active = dep
+
+
+        def ready(entry, flag):
+            if flag and entry.active is not None:
+                return True
+            return False
+        """
+    assert not _lint_src(tmp_path, src)
+    # ...while a real re-read in a LATER operand still fires
+    bad = src.replace(
+        "if flag and entry.active is not None:",
+        "if entry.active is not None and entry.active.version > 1:")
+    findings = _lint_src(tmp_path, bad)
+    assert [f.code for f in findings] == ["ZL721"]
+
+
+def test_lock_order_cycle_between_same_named_locks(tmp_path):
+    """Two classes both naming their lock `_lock` must not alias into
+    one graph node — the cross-class cycle is exactly what ZL731
+    exists to catch."""
+    src = """\
+        import threading
+
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+
+        def ab(a, b):
+            with a._lock:
+                with b._lock:
+                    pass
+
+
+        def ba(a, b):
+            with b._lock:
+                with a._lock:
+                    pass
+        """
+    findings = _lint_src(tmp_path, src)
+    assert [f.code for f in findings] == ["ZL731"]
+
+
+def test_lock_order_cycle_spanning_three_locks(tmp_path):
+    src = """\
+        import threading
+
+
+        class M:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self._c_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._b_lock:
+                    with self._c_lock:
+                        pass
+
+            def three(self):
+                with self._c_lock:
+                    with self._a_lock:
+                        pass
+        """
+    findings = _lint_src(tmp_path, src)
+    assert [f.code for f in findings] == ["ZL731"]
+    assert "_a_lock" in findings[0].message
+
+
+def test_rlock_reentry_is_not_a_cycle(tmp_path):
+    src = """\
+        import threading
+
+
+        class M:
+            def __init__(self):
+                self._cond = threading.Condition(threading.RLock())
+
+            def outer(self):
+                with self._cond:
+                    self.inner()
+
+            def inner(self):
+                with self._cond:
+                    pass
+        """
+    assert not _lint_src(tmp_path, src)
+
+
+# ------------------------------------------------------ CLI surface
+def test_explain_known_code_exits_zero(capsys):
+    rc = zoolint_main(["--explain", "ZL702"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ZL702" in out
+    assert "bad:" in out and "good:" in out
+    assert "docs/dev/zoolint.md" in out
+
+
+def test_explain_unknown_code_exits_two(capsys):
+    rc = zoolint_main(["--explain", "ZL999"])
+    assert rc == 2
+    assert "unknown rule code" in capsys.readouterr().err
+
+
+def test_catalog_covers_every_rule_code():
+    for code in ALL_CODES:
+        assert code in CATALOG, f"--explain missing for {code}"
+        text = explain(code)
+        assert text and "bad:" in text and "good:" in text
+
+
+def test_exit_code_contract(tmp_path, capsys):
+    """0 clean / 2 usage / 3 findings — pinned for scripts/lint.sh."""
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert zoolint_main([str(clean)]) == 0
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(open(os.path.join(FIXTURES,
+                                       "zl701_pos.py")).read())
+    assert zoolint_main([str(dirty), "--root", str(tmp_path)]) == 3
+    assert zoolint_main([]) == 2  # no paths, no --explain: usage
+    capsys.readouterr()
+
+
+def test_format_json_payload_and_summary(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(open(os.path.join(FIXTURES,
+                                       "zl701_pos.py")).read())
+    rc = zoolint_main([str(dirty), "--root", str(tmp_path),
+                       "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 3 and data["exit"] == 3
+    assert data["summary"]["total"] == 1
+    assert data["summary"]["by_code"] == {"ZL701": 1}
+    f = data["findings"][0]
+    assert f["code"] == "ZL701" and f["path"] == "dirty.py"
+    assert f["docs"].startswith("docs/dev/zoolint.md#")
+
+
+def test_lint_sh_emits_per_code_summary_line():
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "lint.sh")],
+        cwd=REPO, timeout=300, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "zoolint summary: total=0" in proc.stdout
+    assert "zoolint OK" in proc.stdout
+
+
+# --------------------------------------- invariant-snapshot sanitizer
+def test_invariant_snapshot_passes_on_warmed_serve_loop(zoolint_sanitize):
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    im = InferenceModel(max_batch_size=8, coalescing=True)
+    im.load_jax(lambda p, x: x @ p["w"],
+                {"w": np.eye(4, dtype=np.float32)})
+    im.warmup((4,))
+    im.predict(np.ones((2, 4), np.float32))  # fully warmed + quiesced
+
+    def invariants():
+        return {"pending": im.serving_stats().get(
+            "coalescer_pending", 0)}
+
+    with zoolint_sanitize(max_compiles=0, invariants=invariants) as rep:
+        for n in (1, 2, 3, 5, 8, 1, 4):
+            im.predict(np.ones((n, 4), np.float32))
+    assert rep.compiles == 0
+    im.close()
+
+
+def test_invariant_snapshot_catches_injected_counter_leak(
+        zoolint_sanitize):
+    from analytics_zoo_tpu.tools.zoolint import InvariantLeakDetected
+    gauges = {"slot_inflight": 0, "tickets": 3}
+    with pytest.raises(InvariantLeakDetected, match="slot_inflight"):
+        with zoolint_sanitize(max_compiles=0, transfer_guard=None,
+                              invariants=lambda: dict(gauges)):
+            gauges["slot_inflight"] += 1  # the seat nobody returns
+
+
+def test_invariant_snapshot_catches_leaked_thread(zoolint_sanitize):
+    from analytics_zoo_tpu.tools.zoolint import InvariantLeakDetected
+    release = threading.Event()
+    try:
+        with pytest.raises(InvariantLeakDetected, match="live_threads"):
+            with zoolint_sanitize(max_compiles=0, transfer_guard=None,
+                                  invariants=lambda: {}):
+                t = threading.Thread(target=release.wait, daemon=True)
+                t.start()  # still alive at block exit
+    finally:
+        release.set()
+
+
+def test_invariant_threads_opt_out(zoolint_sanitize):
+    release = threading.Event()
+    try:
+        with zoolint_sanitize(max_compiles=0, transfer_guard=None,
+                              invariants=lambda: {},
+                              invariant_threads=False):
+            threading.Thread(target=release.wait, daemon=True).start()
+    finally:
+        release.set()
+
+
+# ------------------------------------------ pinned fixes in serving/
+def test_registry_models_survives_concurrent_undeploy_null():
+    """Regression for the ZL721 finding in ModelRegistry.models(): a
+    concurrent undeploy nulling entry.active between a truthiness
+    check and a second read crashed the listing.  The fix reads the
+    deployment exactly once — pinned with an entry whose ``active``
+    disappears after the first access."""
+    from analytics_zoo_tpu.serving.registry import ModelRegistry
+
+    class _Dep:
+        version = 7
+
+    class _FlippingEntry:
+        def __init__(self):
+            self._reads = 0
+
+        @property
+        def active(self):
+            self._reads += 1
+            # first read: live deployment; any re-read: undeployed
+            return _Dep() if self._reads == 1 else None
+
+    reg = ModelRegistry()
+    entry = _FlippingEntry()
+    reg._entries["m"] = entry
+    assert reg.models() == {"m": 7}  # a re-read would AttributeError
+    assert entry._reads == 1

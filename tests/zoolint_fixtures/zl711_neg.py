@@ -1,0 +1,17 @@
+import jax
+
+
+def make_step(fn):
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+class Engine:
+    def __init__(self, fn, caches):
+        self._step = make_step(fn)
+        self._caches = caches
+
+    def run(self, tok):
+        # the slot-array protocol: the donated state is rebound from
+        # the call's result in the same statement
+        self._caches, out = self._step(self._caches, tok)
+        return self._caches, out

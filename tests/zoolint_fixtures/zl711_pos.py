@@ -1,0 +1,15 @@
+import jax
+
+
+def make_step(fn):
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+class Engine:
+    def __init__(self, fn, caches):
+        self._step = make_step(fn)
+        self._caches = caches
+
+    def run(self, tok):
+        out = self._step(self._caches, tok)
+        return self._caches, out  # donated buffer read after the call
