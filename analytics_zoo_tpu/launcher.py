@@ -19,9 +19,23 @@ Three modes:
   each) — the reference's ``local[n]`` testing story at process
   granularity.
 
+Local fan-out is a *supervisor*, the coarse-grained recovery loop of
+the reference's failure story (wp-bigdl: relaunch the job from the last
+complete checkpoint): any worker exiting nonzero — or a worker whose
+heartbeat file goes stale past ``--watchdog-sec`` (a hang in a dead
+collective), which gets SIGKILLed — tears down the whole pod
+immediately (no survivor is ever left blocked in a collective until
+timeout) and, within ``--max-restarts``, relaunches it with
+``ZOO_RESUME=1`` so a checkpointing ``Trainer.fit`` resumes from the
+newest complete snapshot.  Restarts back off exponentially from
+``--restart-backoff``.  See ``train/faults.py`` for the full worker-side
+env contract and ``docs/distributed-training.md`` for the semantics.
+
 Examples:
   zoo-tpu-submit train.py --epochs 10
   zoo-tpu-submit --num-processes 2 --devices-per-process 4 train.py
+  zoo-tpu-submit --num-processes 2 --max-restarts 3 --watchdog-sec 300 \\
+      train.py
   zoo-tpu-submit --coordinator host0:9876 --num-processes 16 \\
       --process-id 3 train.py
 """
@@ -29,6 +43,7 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import re
@@ -36,15 +51,30 @@ import runpy
 import socket
 import subprocess
 import sys
-from typing import List, Optional
+import tempfile
+import time
+from typing import List, Optional, Tuple
 
 from .parallel.distributed import ENV_COORD, ENV_NPROC, ENV_PID
+from .train import faults
+from .train import metrics as train_metrics
 
 
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+# worker-0 stderr signatures of the coordinator failing to bind the
+# probed port (the _free_port TOCTOU race): retried with a fresh port,
+# without consuming the crash-restart budget
+_BIND_ERR_RE = re.compile(
+    r"(?i)address already in use|errno 98|eaddrinuse|failed to bind|"
+    r"bind failed|error binding")
+_PORT_RETRIES = 3
+_STARTUP_WINDOW_S = 60.0
+_MAX_BACKOFF_S = 30.0
 
 
 def _run_script(script: str, script_args: List[str]):
@@ -69,6 +99,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(local fan-out mode)")
     parser.add_argument("--platform", default=None,
                         help="force JAX_PLATFORMS (e.g. cpu)")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="local fan-out: relaunch a crashed/hung pod "
+                             "up to this many times with ZOO_RESUME=1 "
+                             "(0 = supervise + reap only)")
+    parser.add_argument("--restart-backoff", type=float, default=1.0,
+                        help="base seconds between relaunches "
+                             "(doubles per restart, capped at 30s)")
+    parser.add_argument("--watchdog-sec", type=float, default=0.0,
+                        help="SIGKILL + relaunch the pod when a worker's "
+                             "heartbeat file goes stale this long "
+                             "(0 disables; heartbeats come from "
+                             "Trainer.fit steps, so size the window "
+                             "above your longest compile+step)")
+    parser.add_argument("--summary-json", default=None,
+                        help="write a supervision summary (restarts, "
+                             "reasons, rc) to this path on exit")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -100,12 +146,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         _run_script(args.script, args.script_args)
         return 0
 
-    # local fan-out: a real jax.distributed cluster on this machine.
-    # The probed port can in principle be taken before worker 0 rebinds
-    # it (collision surfaces as a startup error) — pass --coordinator
-    # explicitly to pin a reserved port.
-    coordinator = args.coordinator or f"localhost:{_free_port()}"
-    procs = []
+    # local fan-out: a real jax.distributed cluster on this machine,
+    # run under the supervisor (crash/hang detection, pod-wide reap,
+    # bounded relaunch-with-resume).
+    return _run_supervised(args)
+
+
+def _spawn_pod(args, coordinator: str, run_dir: str, incarnation: int,
+               resume: bool) -> Tuple[list, List[str], List[str]]:
+    """Launch all worker processes of one pod incarnation.  Worker
+    stderr goes to per-worker files (replayed by the supervisor at pod
+    end) so bind-race detection can read worker 0's traceback."""
+    procs, hb_paths, err_paths = [], [], []
     for pid in range(args.num_processes):
         env = dict(os.environ)
         env[ENV_COORD] = coordinator
@@ -122,29 +174,204 @@ def main(argv: Optional[List[str]] = None) -> int:
         env["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count="
             f"{args.devices_per_process}").strip()
-        procs.append(subprocess.Popen(
-            [sys.executable, args.script] + list(args.script_args),
-            env=env))
-    rc = 0
-    try:
-        for p in procs:
-            rc = p.wait() or rc
-    except KeyboardInterrupt:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        # give workers a grace window (mid-write checkpoint shards)
-        # before the finally block hard-kills survivors
-        for p in procs:
+        # supervision contract: a fresh heartbeat file per incarnation
+        # (stale mtimes from the previous one must not mask a hang),
+        # ZOO_RESUME only on relaunches (train/faults.py)
+        hb = os.path.join(run_dir, f"hb_p{pid}.r{incarnation}")
+        env[faults.ENV_HEARTBEAT] = hb
+        hb_paths.append(hb)
+        if resume:
+            env[faults.ENV_RESUME] = "1"
+            env[faults.ENV_RESTART_COUNT] = str(incarnation)
+        err = os.path.join(run_dir, f"stderr_p{pid}.r{incarnation}.log")
+        err_paths.append(err)
+        with open(err, "wb") as errf:
+            procs.append(subprocess.Popen(
+                [sys.executable, args.script] + list(args.script_args),
+                env=env, stderr=errf))
+    return procs, hb_paths, err_paths
+
+
+def _supervise(procs: list, hb_paths: List[str], watchdog_sec: float,
+               started: float, poll_s: float = 0.2):
+    """Monitor one pod incarnation until it resolves.
+
+    Returns ``("ok", None)`` when every worker exited zero,
+    ``("exit", rank)`` on the first nonzero exit (partial pod death
+    must be reaped immediately — survivors are blocked in collectives),
+    or ``("watchdog", rank)`` when a live worker's heartbeat file is
+    stale past the window.  Staleness only applies once the worker has
+    created its heartbeat file (at jax.distributed join, then per
+    training step) — the import/cluster-join phase is covered by worker
+    exits, not mtimes."""
+    while True:
+        alive = False
+        for rank, p in enumerate(procs):
+            rc = p.poll()
+            if rc is None:
+                alive = True
+            elif rc != 0:
+                return "exit", rank
+        if not alive:
+            return "ok", None
+        if watchdog_sec:
+            now = time.time()
+            for rank, (p, hb) in enumerate(zip(procs, hb_paths)):
+                if p.poll() is not None:
+                    continue
+                try:
+                    last = os.path.getmtime(hb)
+                except OSError:
+                    continue  # no heartbeat yet: still starting up
+                if now - max(last, started) > watchdog_sec:
+                    return "watchdog", rank
+        time.sleep(poll_s)
+
+
+def _reap_pod(procs: list, grace_s: float = 5.0,
+              kill_first: Optional[int] = None) -> None:
+    """Tear the whole pod down: SIGKILL the hung worker (if any), then
+    terminate + grace-wait + kill the rest.  Runs on EVERY pod exit so
+    a partial death never leaves survivors blocked in a collective
+    until timeout — --max-restarts 0 included."""
+    if kill_first is not None and procs[kill_first].poll() is None:
+        procs[kill_first].kill()
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + grace_s
+    for p in procs:
+        if p.poll() is None:
             try:
-                p.wait(timeout=10)
+                p.wait(timeout=max(0.1, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 pass
-        rc = 130
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def _replay_stderr(err_paths: List[str]) -> List[str]:
+    """Copy each worker's captured stderr to our stderr (tests and
+    humans both read the launcher's merged output) and return the text
+    per worker for failure classification."""
+    texts = []
+    for rank, path in enumerate(err_paths):
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            data = b""
+        text = data.decode("utf-8", "replace")
+        texts.append(text)
+        if text.strip():
+            sys.stderr.write(f"--- worker {rank} stderr ---\n{text}")
+            if not text.endswith("\n"):
+                sys.stderr.write("\n")
+            sys.stderr.flush()
+    return texts
+
+
+def _run_supervised(args) -> int:
+    import shutil
+    from .observability.log import get_logger
+    slog = get_logger("analytics_zoo_tpu.launcher")
+    run_dir = tempfile.mkdtemp(prefix="zoo-pod-")
+    coordinator = args.coordinator or f"localhost:{_free_port()}"
+    reasons: List[str] = []
+    rc = 1
+    try:
+        rc = _supervision_loop(args, slog, run_dir, coordinator,
+                               reasons)
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+        restarts = sum(1 for r in reasons if r in ("exit", "watchdog"))
+        port_retries = reasons.count("port")
+        if args.summary_json:
+            with open(args.summary_json, "w") as f:
+                json.dump({"rc": rc, "restarts": restarts,
+                           "port_retries": port_retries,
+                           "reasons": reasons,
+                           "metrics": train_metrics.snapshot()}, f)
+        if rc == 0:
+            shutil.rmtree(run_dir, ignore_errors=True)
+        else:
+            # keep heartbeat/stderr artifacts for the postmortem
+            slog.info("supervision artifacts kept", run_dir=run_dir,
+                      rc=rc)
+    return rc
+
+
+def _supervision_loop(args, slog, run_dir: str, coordinator: str,
+                      reasons: List[str]) -> int:
+    restarts = 0
+    port_retries = 0
+    incarnation = 0
+    rc = 1
+    while True:
+        started = time.time()
+        procs, hb_paths, err_paths = _spawn_pod(
+            args, coordinator, run_dir, incarnation,
+            resume=restarts > 0)
+        try:
+            outcome, rank = _supervise(procs, hb_paths,
+                                       args.watchdog_sec, started)
+        except KeyboardInterrupt:
+            # grace window first (mid-write checkpoint shards), then kill
+            _reap_pod(procs, grace_s=10.0)
+            _replay_stderr(err_paths)
+            reasons.append("interrupt")
+            rc = 130
+            break
+        if outcome == "ok":
+            _replay_stderr(err_paths)
+            rc = 0
+            break
+        failed_rc = procs[rank].returncode if outcome == "exit" else None
+        _reap_pod(procs, grace_s=5.0,
+                  kill_first=rank if outcome == "watchdog" else None)
+        texts = _replay_stderr(err_paths)
+        incarnation += 1
+        # the documented _free_port race: worker 0 died at startup
+        # failing to bind the probed coordinator port — retry the pod
+        # on a fresh port without consuming the crash-restart budget
+        if (outcome == "exit" and rank == 0 and not args.coordinator
+                and time.time() - started < _STARTUP_WINDOW_S
+                and port_retries < _PORT_RETRIES
+                and _BIND_ERR_RE.search(texts[0] if texts else "")):
+            port_retries += 1
+            reasons.append("port")
+            train_metrics.record_restart("port")
+            coordinator = f"localhost:{_free_port()}"
+            slog.warning("coordinator port collision — relaunching pod "
+                         "on a fresh port", retry=port_retries,
+                         coordinator=coordinator)
+            continue
+        if restarts >= args.max_restarts:
+            slog.error("pod failed and the restart budget is exhausted",
+                       reason=outcome, rank=rank, rc=failed_rc,
+                       restarts=restarts,
+                       max_restarts=args.max_restarts)
+            if failed_rc is None or failed_rc == 0:
+                rc = 1
+            elif failed_rc > 0:
+                rc = failed_rc
+            else:  # died on a signal: shell-style 128+N
+                rc = 128 - failed_rc
+            break
+        restarts += 1
+        reasons.append(outcome)
+        train_metrics.record_restart(outcome)
+        backoff = min(args.restart_backoff * (2 ** (restarts - 1)),
+                      _MAX_BACKOFF_S)
+        slog.warning("pod worker failed — relaunching with ZOO_RESUME",
+                     reason=outcome, rank=rank, rc=failed_rc,
+                     restart=restarts, max_restarts=args.max_restarts,
+                     backoff_s=round(backoff, 3))
+        time.sleep(backoff)
     return rc
 
 

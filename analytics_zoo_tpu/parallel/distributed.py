@@ -104,6 +104,14 @@ def maybe_initialize_distributed() -> bool:
     log.info("jax.distributed: process %d/%d, %d local / %d global devices",
              jax.process_index(), jax.process_count(),
              jax.local_device_count(), jax.device_count())
+    # first liveness touch at cluster join (local import: this module
+    # loads during the train package's own import) — the supervisor's
+    # watchdog then covers the first-compile window too, not just
+    # steady-state steps (train/faults.py; size --watchdog-sec above
+    # the longest compile + step)
+    from ..train import faults
+    faults.refresh()
+    faults.heartbeat()
     return True
 
 

@@ -57,7 +57,12 @@ DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        "dispatch_padded", "dispatch", "pack",
                        "tick", "_resolve_hedged", "maybe_reprobe",
                        "_loop_inner", "_admit_slot",
-                       "lookup", "rehydrate")
+                       "lookup", "rehydrate",
+                       # fault-tolerant training: the launcher's
+                       # supervision poll loop and the per-step worker
+                       # heartbeat both sit on latency-critical paths
+                       # (detection latency / the training step)
+                       "_supervise", "heartbeat")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

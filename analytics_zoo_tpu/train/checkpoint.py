@@ -27,14 +27,22 @@ Spark lineage to lean on).
 from __future__ import annotations
 
 import atexit
+import hashlib
 import json
 import os
 import re
 import threading
-from typing import Any, Optional
+import time
+from typing import Any, Optional, Tuple
 
 import numpy as np
 import jax
+
+from . import faults
+from . import metrics as train_metrics
+from ..observability.log import get_logger
+
+_log = get_logger("analytics_zoo_tpu.train.checkpoint")
 
 
 def _path_name(path) -> str:
@@ -70,6 +78,11 @@ def save_checkpoint(directory: str, tag: Any, tree, overwrite: bool = True,
     manifest = {"names": names, "tag": str(tag), "meta": meta or {}}
     with open(os.path.join(directory, f"ckpt_{tag}.json"), "w") as f:
         json.dump(manifest, f)
+    # the commit manifest is the LAST write: its atomic rename is the
+    # one event that makes this tag restorable
+    _write_commit(directory, tag,
+                  [f"ckpt_{tag}.npz", f"ckpt_{tag}.json"], 1)
+    train_metrics.record_ckpt_save("flat")
     return path
 
 
@@ -107,9 +120,111 @@ def wait_pending(directory: Optional[str] = None):
 atexit.register(wait_pending)
 
 
-def latest_tag(directory: str) -> Optional[str]:
-    if not os.path.isdir(directory):
+# --------------------------------------------------- commit protocol ----
+#
+# Crash safety: a checkpoint directory is only as trustworthy as its
+# *last complete* member.  An async save interrupted by a crash leaves
+# shard files half-written (or some processes' shards missing entirely)
+# under a perfectly plausible tag — blind newest-tag selection would
+# restore torn state.  Every save therefore ends with a COMMIT MANIFEST
+# (``ckpt_<tag>.commit.json``): per-file byte sizes + sha256, written
+# tmp+atomic-rename as the final step (execstore-style).  Selection
+# only considers committed tags; restore re-verifies the checksums and
+# discards a tag that fails them, falling back to the newest complete
+# one.  A crash may cost lost steps — never a wrong or torn restore.
+
+_COMMIT_VERSION = 1
+_COMMIT_WAIT_S = 120.0  # async pod commit: shared-fs wait for all shards
+
+
+def _commit_path(directory: str, tag: Any) -> str:
+    return os.path.join(directory, f"ckpt_{tag}.commit.json")
+
+
+def _digest_file(path: str, chunk: int = 1 << 20) -> Tuple[int, str]:
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            size += len(block)
+            h.update(block)
+    return size, h.hexdigest()
+
+
+def _write_commit(directory: str, tag: Any, filenames, n_processes: int):
+    files = {}
+    for fn in filenames:
+        size, sha = _digest_file(os.path.join(directory, fn))
+        files[fn] = {"bytes": size, "sha256": sha}
+    payload = {"version": _COMMIT_VERSION, "tag": str(tag),
+               "n_processes": n_processes, "files": files}
+    path = _commit_path(directory, tag)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    train_metrics.record_ckpt_commit()
+
+
+def read_commit(directory: str, tag: Any) -> Optional[dict]:
+    """The commit manifest for ``tag``, or None when the tag was never
+    committed (torn/in-flight save, or a pre-commit-protocol save)."""
+    try:
+        with open(_commit_path(directory, tag)) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
         return None
+    if not isinstance(payload.get("files"), dict):
+        return None
+    return payload
+
+
+def verify_commit(directory: str, tag: Any,
+                  deep: bool = False) -> Tuple[bool, str]:
+    """Check every file the commit manifest covers.  Shallow (selection
+    time): presence + byte size.  ``deep`` (restore time): full sha256
+    — a bit-flipped shard is convicted here."""
+    commit = read_commit(directory, tag)
+    if commit is None:
+        return False, "no commit manifest"
+    for fn, rec in commit["files"].items():
+        path = os.path.join(directory, fn)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return False, f"{fn} missing"
+        if size != rec.get("bytes"):
+            return False, (f"{fn} is {size} bytes, commit recorded "
+                           f"{rec.get('bytes')}")
+        if deep:
+            _, sha = _digest_file(path)
+            if sha != rec.get("sha256"):
+                return False, f"{fn} sha256 mismatch"
+    return True, "ok"
+
+
+def discard_tag(directory: str, tag: Any) -> None:
+    """Delete every file of ``tag`` (a corrupt/torn checkpoint must not
+    be re-selected — or re-verified — on the next restore).  Races with
+    another pod process discarding the same tag are benign."""
+    tag_re = re.escape(str(tag))
+    pats = [rf"ckpt_{tag_re}(\.shard-p\d+)?\.npz(\.tmp\.npz)?$",
+            rf"ckpt_{tag_re}\.json$",
+            rf"ckpt_{tag_re}\.commit\.json(\.tmp)?$"]
+    for f in os.listdir(directory):
+        if any(re.match(p, f) for p in pats):
+            try:
+                os.remove(os.path.join(directory, f))
+            except OSError:
+                pass  # another process won the race
+
+
+def _all_tags(directory: str) -> set:
     tags = set()
     for f in os.listdir(directory):
         if f.endswith(".tmp.npz"):  # in-flight/aborted atomic write
@@ -117,22 +232,83 @@ def latest_tag(directory: str) -> Optional[str]:
         m = re.match(r"ckpt_(.+?)(\.shard-p\d+)?\.npz$", f)
         if m:
             tags.add(m.group(1))
-    tags = sorted(tags)
+    return tags
+
+
+def _numeric_tag_key(t):
+    m = re.search(r"(\d+)$", t)
+    return int(m.group(1)) if m else -1
+
+
+def latest_tag(directory: str) -> Optional[str]:
+    """Newest COMPLETE tag: only tags with a (shallow-)valid commit
+    manifest are candidates — a tag whose shards exist but whose commit
+    never landed is an in-flight/torn save and is skipped.  Directories
+    written before the commit protocol (no manifest on ANY tag) keep
+    the legacy newest-tag behavior so old checkpoints stay loadable."""
+    if not os.path.isdir(directory):
+        return None
+    tags = _all_tags(directory)
     if not tags:
         return None
+    committed = {t for t in tags if read_commit(directory, t) is not None}
+    if committed:
+        candidates = [t for t in committed
+                      if verify_commit(directory, t)[0]]
+        if not candidates:
+            return None  # every committed tag is damaged: cold start
+    else:
+        candidates = sorted(tags)  # legacy (pre-commit) directory
+    return max(candidates, key=_numeric_tag_key)
 
-    def key(t):
-        m = re.search(r"(\d+)$", t)
-        return int(m.group(1)) if m else -1
 
-    return max(tags, key=key)
+def _resolve_tag(directory: str, tag: Any):
+    """The restore-side tag selection + verification loop.
+
+    Explicit ``tag``: deep-verify when committed (legacy uncommitted
+    tags pass through — the caller asked for exactly this one) and
+    raise on mismatch.  ``tag=None``: newest complete tag, deep-verified;
+    a tag failing its checksums is DELETED and selection falls back to
+    the next newest complete one — repeat until a verified tag or a
+    clean ``FileNotFoundError`` (cold start)."""
+    if tag is not None:
+        if read_commit(directory, tag) is not None:
+            ok, why = verify_commit(directory, tag, deep=True)
+            if not ok:
+                raise ValueError(
+                    f"checkpoint {tag} fails its commit manifest ({why})"
+                    " — torn or corrupt (missing/damaged shard data)")
+        return tag
+    condemned: set = set()
+    while True:
+        t = latest_tag(directory)
+        if t is None:
+            raise FileNotFoundError(f"No checkpoints in {directory}")
+        if t in condemned:
+            # discard_tag could not actually remove it (read-only
+            # mirror, permissions) — refuse rather than spin forever
+            raise ValueError(
+                f"checkpoint {t} failed verification but could not be "
+                "removed (read-only checkpoint directory?) — refusing "
+                "to restore a corrupt checkpoint")
+        if read_commit(directory, t) is None:
+            return t  # legacy directory: no checksums to hold it to
+        ok, why = verify_commit(directory, t, deep=True)
+        if ok:
+            return t
+        _log.warning("discarding corrupt checkpoint", tag=t, reason=why,
+                     directory=directory)
+        train_metrics.record_ckpt_restore("corrupt_discarded")
+        condemned.add(t)
+        discard_tag(directory, t)
 
 
-def restore_checkpoint(directory: str, template, tag: Any = None):
-    """Load ``ckpt_<tag>`` into the structure of ``template``."""
-    tag = tag if tag is not None else latest_tag(directory)
-    if tag is None:
-        raise FileNotFoundError(f"No checkpoints in {directory}")
+def restore_checkpoint(directory: str, template, tag: Any = None,
+                       _record: bool = True):
+    """Load ``ckpt_<tag>`` into the structure of ``template``.  With
+    ``tag=None`` the newest *complete* checkpoint is selected (commit
+    manifest verified; corrupt tags deleted and skipped)."""
+    tag = _resolve_tag(directory, tag)
     path = os.path.join(directory, f"ckpt_{tag}.npz")
     data = np.load(path)
     leaves = [data[f"arr_{i}"] for i in range(len(data.files))]
@@ -166,6 +342,8 @@ def restore_checkpoint(directory: str, template, tag: Any = None):
         if np.shape(tmpl) != loaded.shape:
             raise ValueError(
                 f"Leaf shape mismatch: {np.shape(tmpl)} vs {loaded.shape}")
+    if _record:
+        train_metrics.record_ckpt_restore("ok")
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -469,7 +647,40 @@ def _write_shards(directory: str, tag: Any, pid: int, n_processes: int,
                     "names": names, "shapes": shapes, "dtypes": dtypes}
         with open(os.path.join(directory, f"ckpt_{tag}.json"), "w") as f:
             json.dump(manifest, f)
+    train_metrics.record_ckpt_save("sharded")
     return path
+
+
+def _commit_sharded(directory: str, tag: Any, n_processes: int,
+                    wait_s: Optional[float] = None) -> bool:
+    """Rank 0's pod-level commit: require EVERY process's shard file
+    present, then write the commit manifest (atomic rename, the final
+    step).  Presence == complete because shard writes are tmp+rename.
+    The sync save path reaches here after a device barrier (the wait
+    loop exits immediately); the async path has no barrier available on
+    a writer thread, so this waits on the shared filesystem instead —
+    on timeout the tag simply stays uncommitted (never restorable),
+    which is the fail-safe outcome."""
+    shard_files = [f"ckpt_{tag}.shard-p{p}.npz" for p in range(n_processes)]
+    covered = shard_files + [f"ckpt_{tag}.json"]
+    deadline = time.monotonic() + (_COMMIT_WAIT_S if wait_s is None
+                                   else wait_s)
+    while True:
+        missing = [f for f in covered
+                   if not os.path.exists(os.path.join(directory, f))]
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            _log.error("checkpoint commit timed out waiting for shards — "
+                       "tag left uncommitted (will never be restored)",
+                       tag=str(tag), missing=missing)
+            return False
+        time.sleep(0.05)
+    _write_commit(directory, tag, covered, n_processes)
+    # drill hook: a post-commit corruption is exactly what restore-side
+    # checksum verification exists to catch
+    faults.maybe_corrupt_shard(directory, tag)
+    return True
 
 
 def _pod_barrier(name: str):
@@ -490,15 +701,26 @@ def save_sharded(directory: str, tag: Any, tree, overwrite: bool = True,
     processes have written (pod barrier), so a restore anywhere on the pod
     immediately after is safe."""
     names, shapes, dtypes, arrays = _snapshot_shards(tree)
+    wrote = False
     try:
         path = _write_shards(directory, tag, jax.process_index(),
                              jax.process_count(), names, shapes, dtypes,
                              arrays, meta, overwrite)
+        wrote = True
     finally:
         # the barrier must run on EVERY process even when this one's
         # write raises (e.g. overwrite=False and the file exists) —
         # skipping it would leave the rest of the pod blocked forever
         _pod_barrier(f"zoo_ckpt_{tag}")
+        try:
+            # pod-level commit: all shards are durable past the barrier;
+            # rank 0 writes the commit manifest as the final step, and a
+            # second barrier keeps any process from restoring before the
+            # tag is actually committed
+            if wrote and jax.process_index() == 0:
+                _commit_sharded(directory, tag, jax.process_count())
+        finally:
+            _pod_barrier(f"zoo_ckpt_commit_{tag}")
     return path
 
 
@@ -510,10 +732,18 @@ def async_save_sharded(directory: str, tag: Any, tree,
     restoring — ``Trainer.fit`` does both when it returns."""
     names, shapes, dtypes, arrays = _snapshot_shards(tree)
     pid, nproc = jax.process_index(), jax.process_count()
-    t = threading.Thread(
-        target=_write_shards,
-        args=(directory, tag, pid, nproc, names, shapes, dtypes, arrays,
-              meta), daemon=True)
+
+    def _write_and_commit():
+        _write_shards(directory, tag, pid, nproc, names, shapes, dtypes,
+                      arrays, meta)
+        if pid == 0:
+            # no device barrier is available off the main thread; the
+            # commit waits for the other processes' shard files on the
+            # shared filesystem instead (atomic renames make presence
+            # mean complete)
+            _commit_sharded(directory, tag, nproc)
+
+    t = threading.Thread(target=_write_and_commit, daemon=True)
     t.start()
     _PENDING.append((os.path.abspath(directory), t))
     return t
@@ -528,10 +758,14 @@ def restore_sharded(directory: str, template, tag: Any = None,
     Because the on-disk format is mesh-agnostic (global indices), a
     checkpoint saved under one mesh/strategy restores onto ANY other —
     the re-sharding story SURVEY §5 prescribes.  Requires all shard files
-    to be visible (shared filesystem on a pod)."""
-    tag = tag if tag is not None else latest_tag(directory)
-    if tag is None:
-        raise FileNotFoundError(f"No checkpoints in {directory}")
+    to be visible (shared filesystem on a pod).
+
+    With ``tag=None`` only COMPLETE checkpoints are candidates: a tag
+    without a valid commit manifest is skipped, and one whose checksums
+    fail at restore is deleted before falling back to the next newest
+    complete tag (``FileNotFoundError`` when none survive — cold
+    start)."""
+    tag = _resolve_tag(directory, tag)
     # the manifest records how many processes wrote this save; reading
     # exactly that set ignores stale shard files from an older save of
     # the same tag under a larger pod
@@ -557,9 +791,12 @@ def restore_sharded(directory: str, template, tag: Any = None,
                         f))
     if not shard_files:
         # fall back to the flat format for old checkpoints (then place
-        # under the same target shardings)
-        tree = restore_checkpoint(directory, template, tag)
-        return _place_tree(tree, shardings)
+        # under the same target shardings); the tag is already verified,
+        # and counting happens below
+        tree = restore_checkpoint(directory, template, tag, _record=False)
+        tree = _place_tree(tree, shardings)
+        train_metrics.record_ckpt_restore("ok")
+        return tree
     flat, treedef = _flatten_none_aware(template)
     shard_flat = ([None] * len(flat) if shardings is None
                   else _flatten_none_aware(shardings)[0])
@@ -660,6 +897,7 @@ def restore_sharded(directory: str, template, tag: Any = None,
     finally:
         for h in handles:
             h.close()
+    train_metrics.record_ckpt_restore("ok")
     return jax.tree_util.tree_unflatten(treedef, placed)
 
 

@@ -1,0 +1,157 @@
+"""Fault-tolerance env contracts: resume, heartbeat, and fault injection.
+
+This module is the single home of the process-level contracts the
+supervising launcher (``launcher.py``) and the training loop
+(``train/trainer.py``) agree on — BigDL-style coarse-grained recovery
+(reference: docs/docs/wp-bigdl.md failure story; SURVEY §5) needs the
+worker and its supervisor to speak the same env-var protocol:
+
+``ZOO_RESUME``
+    Set by the supervisor on every *relaunch* of a crashed pod.  A
+    ``Trainer.fit`` with a ``set_checkpoint`` directory restores
+    params/opt_state/step/epoch from the newest **complete** checkpoint
+    and fast-forwards the data pipeline to the restored position.  No
+    complete checkpoint → clean cold start (a crash may cost lost
+    steps, never a wrong or torn restore).
+``ZOO_RESTART_COUNT``
+    Informational: which relaunch this incarnation is (1-based).
+``ZOO_HEARTBEAT_FILE``
+    Per-worker liveness file.  The training loop touches it (throttled)
+    every step; the supervisor's watchdog SIGKILLs + relaunches the pod
+    when it goes stale past ``--watchdog-sec`` — the hang-detection
+    half of recovery (a worker stuck in a dead collective never exits
+    on its own).  Deliberately touched from the *training* thread, not
+    a daemon thread: a heartbeat thread would keep beating under a
+    deadlocked main thread, which is exactly the failure the watchdog
+    exists to catch.
+``ZOO_CKPT_SYNC``
+    Makes iteration-trigger checkpoints synchronous (``save_sharded``
+    instead of ``async_save_sharded``) so a fault injected at step k
+    deterministically finds every earlier checkpoint committed — used
+    by the fault drill; production keeps the async default.
+
+Fault-injection hooks (test/drill only — all are one-shot per pod:
+they disarm when ``ZOO_RESUME`` is set, so a restarted pod doesn't
+re-crash at the same step forever):
+
+``ZOO_FAULT_CRASH_STEP`` / ``ZOO_FAULT_CRASH_RANK`` (default 1)
+    SIGKILL this process after completing the given step.
+``ZOO_FAULT_HANG_STEP`` / ``ZOO_FAULT_HANG_RANK`` (default 1)
+    Hang (stop heartbeating) after the given step — watchdog fodder.
+``ZOO_FAULT_CORRUPT_TAG``
+    After rank 0 durably commits this checkpoint tag, flip bytes in its
+    own shard file — the commit manifest's checksums then convict the
+    tag at restore time (torn-restore drill).
+
+Rank here is the launcher's ``ZOO_TPU_PROCESS_ID`` (falling back to
+``JAX_PROCESS_ID``), read from env so this module never imports jax.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+ENV_RESUME = "ZOO_RESUME"
+ENV_RESTART_COUNT = "ZOO_RESTART_COUNT"
+ENV_HEARTBEAT = "ZOO_HEARTBEAT_FILE"
+ENV_CKPT_SYNC = "ZOO_CKPT_SYNC"
+ENV_CRASH_STEP = "ZOO_FAULT_CRASH_STEP"
+ENV_CRASH_RANK = "ZOO_FAULT_CRASH_RANK"
+ENV_HANG_STEP = "ZOO_FAULT_HANG_STEP"
+ENV_HANG_RANK = "ZOO_FAULT_HANG_RANK"
+ENV_CORRUPT_TAG = "ZOO_FAULT_CORRUPT_TAG"
+
+_HEARTBEAT_MIN_INTERVAL_S = 0.5
+
+# refreshed from env by refresh(); cached so the per-step hooks cost one
+# attribute load + branch when nothing is armed
+_hb_path: Optional[str] = None
+_hb_last: float = 0.0
+_crash_step: Optional[int] = None
+_hang_step: Optional[int] = None
+
+
+def _rank() -> int:
+    return int(os.environ.get("ZOO_TPU_PROCESS_ID")
+               or os.environ.get("JAX_PROCESS_ID") or 0)
+
+
+def resume_requested() -> bool:
+    return bool(os.environ.get(ENV_RESUME))
+
+
+def sync_checkpoints() -> bool:
+    return bool(os.environ.get(ENV_CKPT_SYNC))
+
+
+def refresh() -> None:
+    """Re-read the env contract (``Trainer.fit`` calls this at entry so
+    a supervisor-provided environment — or a test's monkeypatch — takes
+    effect without import-order coupling)."""
+    global _hb_path, _crash_step, _hang_step
+    _hb_path = os.environ.get(ENV_HEARTBEAT) or None
+    _crash_step = None
+    _hang_step = None
+    if resume_requested():
+        return  # fault hooks are one-shot: disarmed on a resumed pod
+    rank = _rank()
+    step = os.environ.get(ENV_CRASH_STEP)
+    if step and rank == int(os.environ.get(ENV_CRASH_RANK) or 1):
+        _crash_step = int(step)
+    step = os.environ.get(ENV_HANG_STEP)
+    if step and rank == int(os.environ.get(ENV_HANG_RANK) or 1):
+        _hang_step = int(step)
+
+
+def heartbeat() -> None:
+    """Touch the supervisor's liveness file (throttled; no-op unless the
+    launcher provided ``ZOO_HEARTBEAT_FILE``)."""
+    global _hb_last
+    if _hb_path is None:
+        return
+    now = time.monotonic()
+    if now - _hb_last < _HEARTBEAT_MIN_INTERVAL_S:
+        return
+    _hb_last = now
+    try:
+        with open(_hb_path, "a"):
+            os.utime(_hb_path, None)
+    except OSError:
+        pass  # liveness is best-effort telemetry; never fail training
+
+
+def maybe_fault(step: int) -> None:
+    """Injected crash/hang at the given completed step (drill hook)."""
+    if _crash_step is not None and step == _crash_step:
+        import signal
+        # SIGKILL self: the hardest failure mode the supervisor must
+        # handle — no atexit, no flushes, a torn in-flight checkpoint
+        os.kill(os.getpid(), signal.SIGKILL)
+    if _hang_step is not None and step == _hang_step:
+        while True:  # stop heartbeating; only the watchdog ends this
+            time.sleep(1.0)
+
+
+def maybe_corrupt_shard(directory: str, tag) -> None:
+    """Post-commit byte-flip of rank 0's own shard file for ``tag``
+    (drill hook).  MUST only be called after the commit manifest is
+    durable: corrupting before the digest would bake the bad bytes into
+    the checksums and turn a detectable torn restore into a silently
+    wrong one."""
+    if resume_requested():
+        return
+    want = os.environ.get(ENV_CORRUPT_TAG)
+    if not want or str(tag) != want:
+        return
+    path = os.path.join(directory, f"ckpt_{tag}.shard-p0.npz")
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            byte = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([byte[0] ^ 0xFF]) if byte else b"\xff")
+    except OSError:
+        pass

@@ -1,0 +1,104 @@
+"""Training-side metric families: restarts and checkpoint save/restore.
+
+Process-local labeled counters (the supervisor and each worker count
+their own process's events) exposed as Prometheus families through the
+same :class:`~..observability.metrics.Family` exposition the serving
+stack uses — wire them into any registry with::
+
+    mreg.register_collector(train_families)
+
+Families:
+
+``zoo_train_restarts_total{reason}``
+    Supervisor-side: pod relaunches, by reason (``exit`` — a worker
+    exited nonzero; ``watchdog`` — a heartbeat went stale and the
+    worker was SIGKILLed; ``port`` — the coordinator port race, retried
+    with a fresh port without consuming the restart budget).
+``zoo_ckpt_saves_total{format}``
+    Worker-side: checkpoint writes, by on-disk format
+    (``flat``/``sharded``).
+``zoo_ckpt_commits_total``
+    Worker-side (rank 0): commit manifests durably written.
+``zoo_ckpt_restores_total{outcome}``
+    Worker-side: ``ok`` — a verified restore; ``corrupt_discarded`` — a
+    tag failed its commit checksums and was deleted before falling back;
+    ``cold_start`` — resume was requested but no complete checkpoint
+    existed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+from ..observability.metrics import Family
+
+_lock = threading.Lock()
+_restarts: Dict[str, int] = {}
+_saves: Dict[str, int] = {}
+_restores: Dict[str, int] = {}
+_commits: int = 0
+
+
+def record_restart(reason: str) -> None:
+    with _lock:
+        _restarts[reason] = _restarts.get(reason, 0) + 1
+
+
+def record_ckpt_save(fmt: str) -> None:
+    with _lock:
+        _saves[fmt] = _saves.get(fmt, 0) + 1
+
+
+def record_ckpt_commit() -> None:
+    global _commits
+    with _lock:
+        _commits += 1
+
+
+def record_ckpt_restore(outcome: str) -> None:
+    with _lock:
+        _restores[outcome] = _restores.get(outcome, 0) + 1
+
+
+def snapshot() -> Dict[str, Dict[str, int]]:
+    with _lock:
+        return {"restarts": dict(_restarts), "ckpt_saves": dict(_saves),
+                "ckpt_commits": _commits, "ckpt_restores": dict(_restores)}
+
+
+def reset() -> None:
+    """Test isolation hook."""
+    global _commits
+    with _lock:
+        _restarts.clear()
+        _saves.clear()
+        _restores.clear()
+        _commits = 0
+
+
+def train_families() -> List[Family]:
+    """Current counters as exposition families (a registry collector)."""
+    with _lock:
+        fams = []
+        if _restarts:
+            fams.append(Family(
+                "counter", "zoo_train_restarts_total",
+                "Supervised pod relaunches by reason",
+                [({"reason": r}, v) for r, v in sorted(_restarts.items())]))
+        if _saves:
+            fams.append(Family(
+                "counter", "zoo_ckpt_saves_total",
+                "Checkpoint writes by on-disk format",
+                [({"format": f}, v) for f, v in sorted(_saves.items())]))
+        if _commits:
+            fams.append(Family(
+                "counter", "zoo_ckpt_commits_total",
+                "Checkpoint commit manifests durably written",
+                [({}, _commits)]))
+        if _restores:
+            fams.append(Family(
+                "counter", "zoo_ckpt_restores_total",
+                "Checkpoint restore attempts by outcome",
+                [({"outcome": o}, v) for o, v in sorted(_restores.items())]))
+        return fams

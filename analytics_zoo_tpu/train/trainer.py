@@ -19,6 +19,7 @@ Semantics preserved from the reference:
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from functools import partial
@@ -35,8 +36,10 @@ from ..data.dataset import (Dataset, check_batch_divisibility,
 from ..parallel import distributed as dist_lib
 from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
+from . import faults
+from . import metrics as train_metrics
 from . import triggers as trigger_lib
-from .checkpoint import async_save_sharded
+from .checkpoint import async_save_sharded, save_sharded
 from .checkpoint import wait_pending as checkpoint_lib_wait_pending
 from .summary import TrainSummary, ValidationSummary
 
@@ -415,6 +418,46 @@ class Trainer:
 
     _ckpt_path: Optional[str] = None
     _ckpt_trigger = None
+    _auto_resumed = False
+    _resume_epoch_step = 0
+
+    def _maybe_auto_resume(self):
+        """Supervised-restart contract: under ``ZOO_RESUME`` (set by the
+        launcher on every pod relaunch) a checkpointing fit restores the
+        newest COMPLETE snapshot before training.  No complete snapshot
+        → clean cold start (coarse-grained recovery may cost lost steps,
+        never a torn restore)."""
+        if (self._ckpt_path is None or not faults.resume_requested()
+                or self._auto_resumed
+                or self.state.step or self.state.epoch):
+            return
+        self._auto_resumed = True
+        from ..observability.log import get_logger
+        slog = get_logger("analytics_zoo_tpu.train")
+        try:
+            self.load_weights(self._ckpt_path)
+        except FileNotFoundError:
+            train_metrics.record_ckpt_restore("cold_start")
+            slog.warning(
+                "ZOO_RESUME set but no complete checkpoint found — "
+                "cold start", path=self._ckpt_path)
+            return
+        except Exception as e:
+            # a torn/unreadable checkpoint (e.g. a crash during the
+            # FIRST save, before any commit existed, leaves a legacy-
+            # looking directory) must never be worse than a cold start
+            # under the supervisor contract — a raise here would
+            # crash-loop every resumed incarnation.  The explicit
+            # load_weights path still fails loudly.
+            train_metrics.record_ckpt_restore("cold_start")
+            slog.error(
+                "ZOO_RESUME restore failed — cold start",
+                path=self._ckpt_path,
+                error=f"{type(e).__name__}: {e}")
+            return
+        slog.info("resumed from checkpoint", path=self._ckpt_path,
+                  epoch=self.state.epoch, step=self.state.step,
+                  epoch_step=self._resume_epoch_step)
 
     # ------------------------------------------------------------------
     def fit(self, dataset: Dataset, batch_size: int, end_trigger=None,
@@ -432,6 +475,14 @@ class Trainer:
         dataset shard per step (per-host feeding, reference
         net.py:458-468); single-process it is the whole batch."""
         self.ensure_initialized()
+        faults.refresh()  # supervisor env contract (heartbeat/faults)
+        faults.heartbeat()
+        self._maybe_auto_resume()
+        # mid-epoch resume (iteration-trigger checkpoints): skip the
+        # batches the restored position already consumed so the replayed
+        # step sequence matches the uninterrupted run deterministically
+        resume_skip = int(self._resume_epoch_step or 0)
+        self._resume_epoch_step = 0
         if self._train_step is None:
             self._train_step = self._mesh_scoped(
                 self._build_train_step())
@@ -481,8 +532,16 @@ class Trainer:
                 # still work: the record carries the device scalar and only
                 # such a trigger pays the sync.
                 epoch_losses = []
+                epoch_start_step = st.step - resume_skip
                 batch_it = dataset.batches(per_host_bs, shuffle=shuffle,
                                            seed=self.seed, epoch=st.epoch)
+                if resume_skip:
+                    # the epoch's batch order is deterministic in
+                    # (seed, epoch); dropping the first k batches is the
+                    # data-pipeline fast-forward to the restored step
+                    batch_it = itertools.islice(batch_it, resume_skip,
+                                                None)
+                    resume_skip = 0
                 dev_it = prefetch_iterator(batch_it,
                                            lambda b: self._put_batch(*b))
                 for bx, by in dev_it:
@@ -491,6 +550,10 @@ class Trainer:
                         self._train_step(st.params, st.model_state,
                                          st.opt_state, step_rng, bx, by)
                     st.step += 1
+                    faults.heartbeat()
+                    # injected faults land BEFORE the checkpoint trigger:
+                    # a crash at step k must never leave a step-k tag
+                    faults.maybe_fault(st.step)
                     epoch_samples += batch_size
                     epoch_losses.append(loss)
                     if profiling and st.step >= profile_end_step:
@@ -501,9 +564,12 @@ class Trainer:
                     if self._ckpt_path and not isinstance(
                             self._ckpt_trigger, trigger_lib.EveryEpoch) \
                             and self._ckpt_trigger(it_record):
-                        async_save_sharded(
-                            self._ckpt_path, st.step, st.as_tree(),
-                            meta={"step": st.step, "epoch": st.epoch})
+                        save = (save_sharded if faults.sync_checkpoints()
+                                else async_save_sharded)
+                        save(self._ckpt_path, st.step, st.as_tree(),
+                             meta={"step": st.step, "epoch": st.epoch,
+                                   "epoch_step":
+                                       st.step - epoch_start_step})
                     if end_trigger(it_record):
                         # remember the firing so the outer loop terminates even
                         # for triggers the outer record can't re-evaluate
@@ -538,8 +604,12 @@ class Trainer:
                                 "loss": history["loss"][-1]
                                 if history["loss"] else None}
                 if verbose:
+                    # a resumed epoch whose checkpoint sat exactly on
+                    # the epoch boundary replays zero batches: no loss
+                    lossf = epoch_record["loss"]
                     print(f"[zoo-tpu] epoch {st.epoch} step {st.step} "
-                          f"loss {epoch_record['loss']:.4f} "
+                          f"loss "
+                          f"{'n/a' if lossf is None else f'{lossf:.4f}'} "
                           f"({epoch_samples / elapsed:.0f} samples/s)")
                 if validation_data is not None and validation_trigger(
                         epoch_record):
@@ -552,12 +622,14 @@ class Trainer:
                         self.val_summary.flush()
                     if verbose:
                         print(f"[zoo-tpu]   validation: {results}")
+                faults.heartbeat()
                 if self._ckpt_path and isinstance(self._ckpt_trigger,
                                                   trigger_lib.EveryEpoch):
                     async_save_sharded(self._ckpt_path, f"epoch{st.epoch}",
                                        st.as_tree(),
                                        meta={"step": st.step,
-                                             "epoch": st.epoch})
+                                             "epoch": st.epoch,
+                                             "epoch_step": 0})
         finally:
             # the trace must stop even when fit raises mid-epoch, or
             # profiling stays broken for the process ('trace already
@@ -665,6 +737,7 @@ class Trainer:
                                                batch_sharded=sharded)
             else:
                 mask_dev = full_mask
+            faults.heartbeat()
             bx, by = self._put_batch(bx, by)
             accs, loss_acc = eval_step(
                 self.state.params, self.state.model_state, accs, loss_acc,
@@ -769,3 +842,6 @@ class Trainer:
         meta = read_meta(directory, tag)
         self.state.step = int(meta.get("step", self.state.step))
         self.state.epoch = int(meta.get("epoch", self.state.epoch))
+        # iteration-trigger snapshots land mid-epoch: the next fit()
+        # fast-forwards this many batches into the restored epoch
+        self._resume_epoch_step = int(meta.get("epoch_step", 0))

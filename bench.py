@@ -3054,6 +3054,214 @@ def coldstart_bench(quick: bool = False, selfcheck: bool = False,
     return rc
 
 
+# ----------------------------------------------------------- faulttrain ----
+
+def _faulttrain_worker(argv) -> int:
+    """One pod worker of the fault drill (spawned by the supervising
+    launcher): deterministic seeded 2-process data-parallel training
+    with iteration-trigger checkpoints.  Crash/hang/corruption arrive
+    via the ZOO_FAULT_* env hooks (train/faults.py); resume via the
+    supervisor's ZOO_RESUME contract.  Rank 0 dumps final params for
+    the parent's bit-exactness gate."""
+    out_dir, epochs = argv[0], int(argv[1])
+    import numpy as np
+    import optax
+    import jax
+    from analytics_zoo_tpu.common.context import init_nncontext
+    from analytics_zoo_tpu.data.dataset import Dataset
+    from analytics_zoo_tpu.train.trainer import Trainer
+    from analytics_zoo_tpu.train import triggers
+    from analytics_zoo_tpu.pipeline.api.keras import (Sequential,
+                                                      objectives)
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    ctx = init_nncontext(app_name="fault-drill")
+    m = Sequential()
+    m.add(Dense(32, activation="relu", input_shape=(8,)))
+    m.add(Dense(4))
+    trainer = Trainer(m.to_graph(),
+                      objectives.get("sparse_categorical_crossentropy"),
+                      optax.sgd(0.1, momentum=0.9), mesh=ctx.mesh,
+                      strategy="replicate", seed=0)
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 4, 64).astype(np.int32)
+    ds = Dataset.from_ndarray(x, y)
+    if jax.process_count() > 1:
+        ds = ds.shard_by_process()
+    trainer.set_checkpoint(os.path.join(out_dir, "ckpt"),
+                           trigger=triggers.SeveralIteration(2))
+    trainer.fit(ds, batch_size=16,
+                end_trigger=triggers.MaxEpoch(epochs), shuffle=True)
+    if jax.process_index() == 0:
+        flat = {
+            "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in path): np.asarray(jax.device_get(leaf))
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                trainer.state.params)[0]}
+        np.savez(os.path.join(out_dir, "final_params.npz"), **flat)
+    print(f"FAULT_WORKER_DONE rank={jax.process_index()} "
+          f"step={trainer.state.step} "
+          f"resumed={1 if os.environ.get('ZOO_RESUME') else 0}",
+          flush=True)
+    return 0
+
+
+def faulttrain_bench(quick: bool = False, selfcheck: bool = False,
+                     out_path: str = None) -> int:
+    """Fault-tolerant distributed training drill (``bench.py
+    faulttrain``): three supervised 2-process CPU pods training the
+    SAME seeded workload.
+
+    * baseline — no faults; final params are the golden reference;
+    * crash — worker 1 SIGKILLs itself at step 6 AND the step-4
+      checkpoint's shard is byte-flipped *after* its commit manifest
+      landed: the supervisor must reap + relaunch with ZOO_RESUME, the
+      restore must convict + delete the corrupt tag and fall back to
+      the step-2 one, and the replayed run's final params must be
+      BIT-IDENTICAL to the baseline;
+    * watchdog (full runs only) — worker 1 hangs at step 6, its
+      heartbeat goes stale, the supervisor SIGKILLs + relaunches; the
+      step-6 tag is torn (no commit: worker 1 never wrote its shard)
+      and must be skipped for the committed step-4 one — final params
+      again bit-identical.
+
+    Checkpoints run synchronously (ZOO_CKPT_SYNC) so the drill's
+    pre-crash tag set is deterministic; the recovery machinery under
+    test is identical either way."""
+    import shutil
+    import tempfile
+    import numpy as np
+
+    work = tempfile.mkdtemp(prefix="zoo_faulttrain_")
+    epochs = 3  # 2 procs x 8 rows/step: 4 steps/epoch, 12 total
+    results = {"quick": quick, "epochs": epochs}
+    ok = True
+
+    def run_pod(label: str, extra_env: dict, launcher_args,
+                timeout: float = 900.0):
+        out_dir = os.path.join(work, label)
+        os.makedirs(out_dir)
+        summary = os.path.join(out_dir, "summary.json")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env["JAX_PLATFORMS"] = "cpu"
+        env["ZOO_CKPT_SYNC"] = "1"
+        env.pop("ZOO_RESUME", None)  # a stale outer resume must not leak
+        for k in list(env):
+            if k.startswith("ZOO_FAULT_"):
+                del env[k]
+        env.update(extra_env)
+        cmd = [sys.executable, "-m", "analytics_zoo_tpu.launcher",
+               "--num-processes", "2", "--devices-per-process", "1",
+               "--restart-backoff", "0.25",
+               "--summary-json", summary] + list(launcher_args) + [
+               os.path.abspath(__file__), "--faulttrain-worker",
+               out_dir, str(epochs)]
+        _log(f"faulttrain: launching {label} pod")
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              timeout=timeout, env=env, cwd=REPO)
+        with open(summary) as f:
+            summ = json.load(f)
+        params = None
+        final = os.path.join(out_dir, "final_params.npz")
+        if proc.returncode == 0 and os.path.exists(final):
+            with np.load(final) as z:
+                params = {k: z[k] for k in z.files}
+        return proc, summ, params
+
+    def bitexact(a, b):
+        return (a is not None and b is not None
+                and set(a) == set(b)
+                and all(np.array_equal(a[k], b[k]) for k in a))
+
+    try:
+        base_proc, base_summ, base_params = run_pod("baseline", {}, [])
+        results["baseline"] = {"rc": base_proc.returncode,
+                               "restarts": base_summ["restarts"]}
+        if base_proc.returncode != 0 or base_params is None:
+            raise RuntimeError(
+                "faulttrain baseline pod failed:\n"
+                + base_proc.stdout[-3000:])
+        print(f"FAULT_DRILL_BASELINE steps={epochs * 4} "
+              f"leaves={len(base_params)}", flush=True)
+
+        crash_proc, crash_summ, crash_params = run_pod(
+            "crash",
+            {"ZOO_FAULT_CRASH_STEP": "6", "ZOO_FAULT_CRASH_RANK": "1",
+             "ZOO_FAULT_CORRUPT_TAG": "4"},
+            ["--max-restarts", "2"])
+        crash_bit = bitexact(base_params, crash_params)
+        discarded = "discarding corrupt checkpoint" in crash_proc.stdout
+        resumed = "resumed=1" in crash_proc.stdout
+        results["crash"] = {
+            "rc": crash_proc.returncode,
+            "restarts": crash_summ["restarts"],
+            "reasons": crash_summ["reasons"],
+            "corrupt_discarded": discarded, "resumed": resumed,
+            "bitexact": crash_bit}
+        print(f"FAULT_DRILL_CRASH rc={crash_proc.returncode} "
+              f"restarts={crash_summ['restarts']} "
+              f"reasons={','.join(crash_summ['reasons'])} "
+              f"corrupt_discarded={discarded} bitexact={crash_bit}",
+              flush=True)
+        if not (crash_proc.returncode == 0
+                and crash_summ["restarts"] >= 1
+                and "exit" in crash_summ["reasons"]
+                and discarded and resumed and crash_bit):
+            ok = False
+            _log("faulttrain FAIL: crash+corrupt pod did not recover "
+                 "to bit-identical params:\n"
+                 + crash_proc.stdout[-3000:])
+
+        wd_bit = None
+        if quick:
+            _log("faulttrain: --quick skips the watchdog/hang leg "
+                 "(covered by the full run and test_supervisor)")
+        else:
+            wd_proc, wd_summ, wd_params = run_pod(
+                "watchdog",
+                {"ZOO_FAULT_HANG_STEP": "6", "ZOO_FAULT_HANG_RANK": "1"},
+                ["--max-restarts", "2", "--watchdog-sec", "15"])
+            wd_bit = bitexact(base_params, wd_params)
+            results["watchdog"] = {
+                "rc": wd_proc.returncode,
+                "restarts": wd_summ["restarts"],
+                "reasons": wd_summ["reasons"], "bitexact": wd_bit}
+            print(f"FAULT_DRILL_WATCHDOG rc={wd_proc.returncode} "
+                  f"restarts={wd_summ['restarts']} "
+                  f"reasons={','.join(wd_summ['reasons'])} "
+                  f"bitexact={wd_bit}", flush=True)
+            if not (wd_proc.returncode == 0
+                    and "watchdog" in wd_summ["reasons"] and wd_bit):
+                ok = False
+                _log("faulttrain FAIL: hung pod was not "
+                     "watchdog-recovered to bit-identical params:\n"
+                     + wd_proc.stdout[-3000:])
+
+        if ok:
+            print(f"FAULT_DRILL_RESUME_OK bitexact=1 "
+                  f"legs={'crash' if quick else 'crash,watchdog'}",
+                  flush=True)
+    except (RuntimeError, OSError, KeyError, ValueError,
+            subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+        _log(f"faulttrain FAIL: {type(e).__name__}: {e}")
+        results["error"] = f"{type(e).__name__}: {e}"
+        ok = False
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    print("BENCH_FAULTTRAIN " + json.dumps(results), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+    if selfcheck:
+        print("FAULTTRAIN_SELFCHECK_" + ("OK" if ok else "FAIL"),
+              flush=True)
+    return 0 if (ok or not selfcheck) else 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
@@ -3105,6 +3313,17 @@ if __name__ == "__main__":
         sys.exit(coldstart_bench(quick="--quick" in sys.argv,
                                  selfcheck="--selfcheck" in sys.argv,
                                  out_path=_out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--faulttrain-worker":
+        # one pod worker (spawned by the supervising launcher, which
+        # already set JAX_PLATFORMS / XLA_FLAGS / the cluster env)
+        sys.exit(_faulttrain_worker(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "faulttrain":
+        _out = None
+        if "--out" in sys.argv:
+            _out = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(faulttrain_bench(quick="--quick" in sys.argv,
+                                  selfcheck="--selfcheck" in sys.argv,
+                                  out_path=_out))
     elif len(sys.argv) > 1 and sys.argv[1] == "loadtest":
         # the elastic gates need >1 device: force 2 virtual host
         # devices BEFORE jax initializes (no-op when the caller — the

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Training smoke gate (sibling of smoke_serving.sh): the fault-tolerant
+# distributed-training drill end to end on CPU — a supervised 2-process
+# jax.distributed pod trains a seeded workload, worker 1 SIGKILLs
+# itself mid-epoch while a committed checkpoint's shard is byte-flipped
+# post-commit, and the supervisor must reap the pod, relaunch it with
+# ZOO_RESUME, convict + delete the corrupt tag, resume from the newest
+# complete one, and finish with final params BIT-IDENTICAL to an
+# uninterrupted run (bench.py faulttrain --quick --selfcheck; the full
+# bench run adds the hang/watchdog leg).
+#
+# Runnable standalone like the other gates; the timeout wrapper keeps a
+# wedged pod from hanging CI forever.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+ft=$(timeout -k 10 900 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    python bench.py faulttrain --quick --selfcheck)
+printf '%s\n' "$ft"
+grep -q "FAULT_DRILL_RESUME_OK" <<<"$ft" || {
+    echo "smoke FAIL: crash+resume run did not reproduce the" \
+         "uninterrupted run's params (or the drill never completed)" >&2
+    exit 1
+}
+grep -q "corrupt_discarded=True" <<<"$ft" || {
+    echo "smoke FAIL: the post-commit corrupted checkpoint was not" \
+         "convicted and discarded at restore" >&2
+    exit 1
+}
+grep -q "FAULTTRAIN_SELFCHECK_OK" <<<"$ft" || {
+    echo "smoke FAIL: faulttrain selfcheck gates failed" >&2
+    exit 1
+}
+echo "training smoke OK"

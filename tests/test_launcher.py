@@ -71,6 +71,80 @@ def test_local_fanout_forms_cluster(tmp_path):
     assert len(losses) == 1, lines
 
 
+TRAIN_DEMO = textwrap.dedent("""
+    import os
+    import numpy as np
+    import optax
+    import jax
+    from analytics_zoo_tpu.common.context import init_nncontext
+    from analytics_zoo_tpu.data.dataset import Dataset
+    from analytics_zoo_tpu.train import triggers
+    from analytics_zoo_tpu.train.trainer import Trainer
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, objectives
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+    import sys
+    ckpt_dir = sys.argv[1]
+    ctx = init_nncontext(app_name="supervised-drill")
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(8,)))
+    m.add(Dense(4))
+    trainer = Trainer(m.to_graph(),
+                      objectives.get("sparse_categorical_crossentropy"),
+                      optax.sgd(0.1), mesh=ctx.mesh, seed=0)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 4, 64).astype(np.int32)
+    ds = Dataset.from_ndarray(x, y)
+    if jax.process_count() > 1:
+        ds = ds.shard_by_process()
+    trainer.set_checkpoint(ckpt_dir,
+                           trigger=triggers.SeveralIteration(2))
+    trainer.fit(ds, batch_size=16, end_trigger=triggers.MaxEpoch(3))
+    print(f"RESULT proc={jax.process_index()}/{jax.process_count()} "
+          f"step={trainer.state.step} "
+          f"resumed={1 if os.environ.get('ZOO_RESUME') else 0}",
+          flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_supervisor_recovers_sigkilled_worker_mid_epoch(tmp_path):
+    """The full recovery loop on a REAL 2-process jax.distributed
+    cluster: worker 1 SIGKILLs itself mid-epoch (ZOO_FAULT_CRASH_STEP),
+    the supervisor reaps + relaunches with ZOO_RESUME, and the resumed
+    pod restores the newest complete checkpoint and finishes all 12
+    steps."""
+    import json
+    script = tmp_path / "train_demo.py"
+    script.write_text(TRAIN_DEMO)
+    ckpt = tmp_path / "ckpt"
+    summary = tmp_path / "summary.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["ZOO_FAULT_CRASH_STEP"] = "6"
+    env["ZOO_FAULT_CRASH_RANK"] = "1"
+    env["ZOO_CKPT_SYNC"] = "1"
+    for k in ("ZOO_TPU_COORDINATOR", "ZOO_TPU_NUM_PROCESSES",
+              "ZOO_TPU_PROCESS_ID", "ZOO_RESUME"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.launcher",
+         "--num-processes", "2", "--devices-per-process", "1",
+         "--max-restarts", "2", "--restart-backoff", "0.25",
+         "--summary-json", str(summary),
+         str(script), str(ckpt)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    summ = json.loads(summary.read_text())
+    assert summ["restarts"] == 1 and summ["reasons"] == ["exit"]
+    lines = [l for l in proc.stdout.splitlines() if "RESULT" in l]
+    # the final incarnation completed on both ranks, resumed
+    assert any("proc=0/2 step=12 resumed=1" in l for l in lines), lines
+    assert any("proc=1/2 step=12 resumed=1" in l for l in lines), lines
+
+
 def test_pod_mode_requires_coordinator(tmp_path):
     script = tmp_path / "demo.py"
     script.write_text("print('hi')")

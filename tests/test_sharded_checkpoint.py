@@ -376,6 +376,124 @@ def test_restore_bridges_renamed_layers(tmp_path):
         restore_checkpoint(str(tmp_path / "live"), live_tmpl, 1)
 
 
+def test_commit_manifest_written_last_and_covers_all_files(tmp_path):
+    """Crash-safe commit: every save ends with ckpt_<tag>.commit.json
+    recording byte sizes + sha256 of every file the tag comprises —
+    the atomic rename of that manifest IS the commit point."""
+    import hashlib
+    import json
+    from analytics_zoo_tpu.train.checkpoint import (read_commit,
+                                                    verify_commit)
+    mesh = mesh_lib.create_mesh({"data": 2, "fsdp": 4})
+    placed = {"w": jax.device_put(np.ones((8, 4), np.float32),
+                                  NamedSharding(mesh, P("fsdp", None)))}
+    save_sharded(str(tmp_path), "c1", placed, meta={"step": 1})
+    commit = read_commit(str(tmp_path), "c1")
+    assert set(commit["files"]) == {"ckpt_c1.shard-p0.npz",
+                                    "ckpt_c1.json"}
+    assert commit["n_processes"] == 1
+    for fn, rec in commit["files"].items():
+        path = tmp_path / fn
+        assert path.stat().st_size == rec["bytes"]
+        assert hashlib.sha256(path.read_bytes()).hexdigest() == \
+            rec["sha256"]
+    assert verify_commit(str(tmp_path), "c1", deep=True) == (True, "ok")
+
+
+def test_torn_tag_without_commit_skipped_for_newest_complete(tmp_path):
+    """Selection ignores a tag whose shards exist but whose commit
+    never landed (the crash-mid-async-save signature): latest_tag and
+    tag-less restore both fall back to the newest COMPLETE tag."""
+    from analytics_zoo_tpu.train.checkpoint import latest_tag
+    t1 = {"w": np.full((4, 4), 1.0, np.float32)}
+    t2 = {"w": np.full((4, 4), 2.0, np.float32)}
+    save_sharded(str(tmp_path), 1, t1, meta={"step": 1})
+    save_sharded(str(tmp_path), 2, t2, meta={"step": 2})
+    # tear tag 2: shards on disk, commit manifest gone
+    os.remove(str(tmp_path / "ckpt_2.commit.json"))
+    assert latest_tag(str(tmp_path)) == "1"
+    out = restore_sharded(str(tmp_path),
+                          {"w": np.zeros((4, 4), np.float32)})
+    np.testing.assert_array_equal(out["w"], t1["w"])
+    assert read_meta(str(tmp_path)) == {"step": 1}
+
+
+def test_checksum_mismatch_deletes_tag_and_falls_back(tmp_path):
+    """A committed tag whose shard bytes were damaged after the commit
+    (bit rot, torn overwrite) is convicted by its sha256 at restore,
+    DELETED, and selection falls back — a crash may cost lost steps,
+    never a wrong or torn restore.  With no complete tag left, restore
+    is a clean FileNotFoundError (cold start)."""
+    t1 = {"w": np.full((4, 4), 1.0, np.float32)}
+    t2 = {"w": np.full((4, 4), 2.0, np.float32)}
+    save_sharded(str(tmp_path), 1, t1, meta={"step": 1})
+    save_sharded(str(tmp_path), 2, t2, meta={"step": 2})
+    shard2 = tmp_path / "ckpt_2.shard-p0.npz"
+    data = bytearray(shard2.read_bytes())
+    data[len(data) // 2] ^= 0xFF  # same size, different bytes
+    shard2.write_bytes(bytes(data))
+    out = restore_sharded(str(tmp_path),
+                          {"w": np.zeros((4, 4), np.float32)})
+    np.testing.assert_array_equal(out["w"], t1["w"])
+    # the corrupt tag was deleted wholesale, not just skipped
+    assert not any("ckpt_2" in f for f in os.listdir(tmp_path))
+    # damage the survivor too: no complete tag left -> cold start
+    shard1 = tmp_path / "ckpt_1.shard-p0.npz"
+    data = bytearray(shard1.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    shard1.write_bytes(bytes(data))
+    with pytest.raises(FileNotFoundError):
+        restore_sharded(str(tmp_path),
+                        {"w": np.zeros((4, 4), np.float32)})
+
+
+def test_explicit_corrupt_tag_raises_instead_of_fallback(tmp_path):
+    """An explicitly requested tag that fails its checksums raises
+    (there is no meaningful fallback for a caller who named the tag)."""
+    tree = {"w": np.full((4, 4), 3.0, np.float32)}
+    save_sharded(str(tmp_path), "x", tree)
+    shard = tmp_path / "ckpt_x.shard-p0.npz"
+    data = bytearray(shard.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    shard.write_bytes(bytes(data))
+    with pytest.raises(ValueError, match="commit manifest"):
+        restore_sharded(str(tmp_path),
+                        {"w": np.zeros((4, 4), np.float32)}, "x")
+
+
+def test_undeletable_corrupt_tag_raises_instead_of_spinning(tmp_path,
+                                                            monkeypatch):
+    """When the corrupt tag cannot actually be removed (read-only
+    mirror, permissions — discard_tag swallows the OSError), selection
+    must refuse loudly instead of re-verifying the same tag forever."""
+    from analytics_zoo_tpu.train import checkpoint as ckpt_lib
+    tree = {"w": np.full((4, 4), 3.0, np.float32)}
+    save_sharded(str(tmp_path), 1, tree)
+    shard = tmp_path / "ckpt_1.shard-p0.npz"
+    data = bytearray(shard.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    shard.write_bytes(bytes(data))
+    monkeypatch.setattr(ckpt_lib, "discard_tag",
+                        lambda *a, **k: None)  # deletion silently fails
+    with pytest.raises(ValueError, match="could not be removed"):
+        restore_sharded(str(tmp_path),
+                        {"w": np.zeros((4, 4), np.float32)})
+
+
+def test_legacy_directory_without_commits_still_restores(tmp_path):
+    """Directories written before the commit protocol (no manifest on
+    ANY tag) keep the legacy newest-tag behavior — old checkpoints
+    stay loadable."""
+    tree = {"w": np.full((2, 2), 5.0, np.float32)}
+    save_sharded(str(tmp_path), 3, tree)
+    os.remove(str(tmp_path / "ckpt_3.commit.json"))
+    from analytics_zoo_tpu.train.checkpoint import latest_tag
+    assert latest_tag(str(tmp_path)) == "3"
+    out = restore_sharded(str(tmp_path),
+                          {"w": np.zeros((2, 2), np.float32)})
+    np.testing.assert_array_equal(out["w"], tree["w"])
+
+
 def test_restore_same_shape_stack_keeps_construction_order(tmp_path):
     """A stack of SAME-shape auto-numbered layers (the transformer-block
     case) must restore in construction order even when (a) the saved

@@ -1,0 +1,183 @@
+"""Supervising launcher (fault-tolerant local fan-out): crash-restart
+with the ZOO_RESUME contract, pod-wide fast-fail reaping at
+--max-restarts 0, heartbeat watchdog SIGKILL+relaunch, and the
+coordinator port-race retry.
+
+These drive the REAL supervisor loop (`launcher._run_supervised`)
+through `python -m analytics_zoo_tpu.launcher`, but with trivial
+non-jax worker scripts so they stay fast enough for tier-1 — the full
+jax.distributed drill lives in test_launcher.py (slow) and
+`bench.py faulttrain`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a fake pod worker: no jax, just the supervision contract.  Modes:
+#   crash   — rank 1 exits 3 on the first incarnation
+#   partial — rank 1 exits 2; rank 0 "blocks in a collective" (sleeps)
+#   hang    — rank 1 heartbeats once then stops (watchdog fodder)
+#   bind    — rank 0 prints a bind error + exits 1 until the flag file
+WORKER = textwrap.dedent("""
+    import os, sys, time
+    rank = int(os.environ.get("ZOO_TPU_PROCESS_ID", "0"))
+    mode, flag = sys.argv[1], sys.argv[2]
+    hb = os.environ.get("ZOO_HEARTBEAT_FILE")
+    resume = os.environ.get("ZOO_RESUME")
+
+    def beat():
+        if hb:
+            with open(hb, "a"):
+                os.utime(hb, None)
+
+    if mode == "crash" and rank == 1 and not resume:
+        sys.exit(3)
+    if mode == "hang" and rank == 1 and not resume:
+        beat()
+        time.sleep(300)
+    if mode == "bind" and rank == 0 and not os.path.exists(flag):
+        open(flag, "w").close()
+        print("RuntimeError: Failed to bind: Address already in use",
+              file=sys.stderr)
+        sys.exit(1)
+    if mode == "partial" and rank == 1:
+        sys.exit(2)
+    if mode == "partial" and rank == 0:
+        time.sleep(300)
+    for _ in range(4):
+        beat()
+        time.sleep(0.05)
+    print(f"DONE rank={rank} resume={resume or 0} "
+          f"restart_count={os.environ.get('ZOO_RESTART_COUNT', 0)}",
+          flush=True)
+""")
+
+
+def _launch(tmp_path, mode, extra_args=(), timeout=120):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    summary = tmp_path / "summary.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    for k in list(env):
+        if k.startswith(("ZOO_TPU_", "ZOO_RESUME", "ZOO_FAULT_",
+                         "JAX_COORDINATOR", "JAX_NUM_PROCESSES",
+                         "JAX_PROCESS_ID")):
+            env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.launcher",
+         "--num-processes", "2", "--restart-backoff", "0.1",
+         "--summary-json", str(summary)] + list(extra_args)
+        + [str(script), mode, str(tmp_path / "flag")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=timeout)
+    summ = json.loads(summary.read_text()) if summary.exists() else None
+    return proc, summ
+
+
+def test_crash_restarts_with_resume_env(tmp_path):
+    """A worker exiting nonzero tears the pod down and relaunches it
+    with ZOO_RESUME=1 within the --max-restarts budget."""
+    proc, summ = _launch(tmp_path, "crash", ["--max-restarts", "1"])
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    assert summ["restarts"] == 1 and summ["reasons"] == ["exit"]
+    # the relaunched incarnation saw the resume contract
+    assert "DONE rank=0 resume=1 restart_count=1" in proc.stdout
+    assert "DONE rank=1 resume=1" in proc.stdout
+    assert summ["metrics"]["restarts"] == {"exit": 1}
+
+
+def test_partial_death_fast_fails_with_no_restarts(tmp_path):
+    """--max-restarts 0: one dead worker must NOT leave the survivor
+    blocked until its own timeout — the supervisor always reaps the
+    pod, and the failing worker's rc propagates."""
+    start = time.time()
+    proc, summ = _launch(tmp_path, "partial")
+    wall = time.time() - start
+    assert proc.returncode == 2, proc.stdout[-2000:]
+    # the survivor "blocks" for 300s; reaping must beat that by far
+    assert wall < 60, f"supervisor waited on the blocked survivor ({wall:.0f}s)"
+    assert summ["restarts"] == 0 and summ["rc"] == 2
+
+
+def test_watchdog_kills_and_restarts_hung_worker(tmp_path):
+    """A stale heartbeat past --watchdog-sec is a hang: SIGKILL the
+    worker, reap the pod, relaunch with resume."""
+    proc, summ = _launch(tmp_path, "hang",
+                         ["--max-restarts", "1", "--watchdog-sec", "2"])
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    assert summ["reasons"] == ["watchdog"], summ
+    assert "DONE rank=1 resume=1" in proc.stdout
+    assert summ["metrics"]["restarts"] == {"watchdog": 1}
+
+
+def test_restart_budget_exhaustion_fails(tmp_path):
+    """A pod that keeps crashing past the budget surfaces the failure
+    rc instead of looping forever (the crash mode only crashes the
+    FIRST incarnation, so --max-restarts 0 must fail)."""
+    proc, summ = _launch(tmp_path, "crash")
+    assert proc.returncode == 3
+    assert summ == {"rc": 3, "restarts": 0, "port_retries": 0,
+                    "reasons": [], "metrics": summ["metrics"]}
+
+
+def test_coordinator_bind_race_retried_with_fresh_port(tmp_path):
+    """The documented _free_port race (launcher.py): worker 0 failing
+    to bind the probed port at startup is retried on a fresh port,
+    WITHOUT consuming the crash-restart budget and WITHOUT setting
+    ZOO_RESUME (nothing trained yet)."""
+    proc, summ = _launch(tmp_path, "bind")  # max-restarts defaults to 0
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    assert summ["port_retries"] == 1 and summ["restarts"] == 0
+    assert summ["reasons"] == ["port"]
+    assert "DONE rank=0 resume=0" in proc.stdout
+
+
+def test_train_metric_families_render_and_parse():
+    """zoo_train_restarts_total / zoo_ckpt_* families round-trip the
+    Prometheus exposition parser (docs/observability.md rows)."""
+    from analytics_zoo_tpu.observability.metrics import (
+        parse_prometheus_text, render_prometheus)
+    from analytics_zoo_tpu.train import metrics as tm
+    state = tm.snapshot()
+    try:
+        tm.reset()
+        tm.record_restart("exit")
+        tm.record_restart("watchdog")
+        tm.record_ckpt_save("sharded")
+        tm.record_ckpt_commit()
+        tm.record_ckpt_restore("ok")
+        tm.record_ckpt_restore("corrupt_discarded")
+        text = render_prometheus(tm.train_families())
+        parsed = parse_prometheus_text(text)
+        assert parsed["types"]["zoo_train_restarts_total"] == "counter"
+        s = parsed["samples"]
+        assert s[("zoo_train_restarts_total", (("reason", "exit"),))] == 1
+        assert s[("zoo_train_restarts_total",
+                  (("reason", "watchdog"),))] == 1
+        assert s[("zoo_ckpt_saves_total", (("format", "sharded"),))] == 1
+        assert s[("zoo_ckpt_restores_total", (("outcome", "ok"),))] == 1
+        assert s[("zoo_ckpt_restores_total",
+                  (("outcome", "corrupt_discarded"),))] == 1
+        assert s[("zoo_ckpt_commits_total", ())] == 1
+    finally:
+        tm.reset()
+        for r, v in state["restarts"].items():
+            for _ in range(v):
+                tm.record_restart(r)
+        for f, v in state["ckpt_saves"].items():
+            for _ in range(v):
+                tm.record_ckpt_save(f)
+        for o, v in state["ckpt_restores"].items():
+            for _ in range(v):
+                tm.record_ckpt_restore(o)
+        for _ in range(state["ckpt_commits"]):
+            tm.record_ckpt_commit()

@@ -152,6 +152,152 @@ def test_eval_mask_with_sequence_output():
     assert float(acc["total"]) == 3.0  # only sample 0's T elements counted
 
 
+def test_resume_after_crash_is_bit_identical(tmp_path, monkeypatch):
+    """The coarse-grained recovery contract: training 'crashed' at step
+    k and resumed under ZOO_RESUME from the newest complete
+    iteration-trigger checkpoint must land on BIT-IDENTICAL params to
+    the uninterrupted run — including the mid-epoch data-pipeline
+    fast-forward (step 6 of a 4-step epoch resumes 2 batches into
+    epoch 1, not at its start)."""
+    import optax
+    import jax
+    from analytics_zoo_tpu.data.dataset import Dataset
+    from analytics_zoo_tpu.train import triggers
+    from analytics_zoo_tpu.train.trainer import Trainer
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+
+    zoo.init_nncontext()
+    x, y = make_data(64)
+    ds = Dataset.from_ndarray(x, y)
+
+    def make_trainer():
+        return Trainer(
+            build_mlp().to_graph(),
+            objectives.get("sparse_categorical_crossentropy"),
+            optax.sgd(0.1, momentum=0.9), seed=0)
+
+    t_full = make_trainer()
+    t_full.fit(ds, batch_size=16, end_trigger=triggers.MaxEpoch(3))
+
+    ckpt = str(tmp_path / "ckpt")
+    monkeypatch.setenv("ZOO_CKPT_SYNC", "1")  # deterministic tag set
+    t_crash = make_trainer()
+    t_crash.set_checkpoint(ckpt, trigger=triggers.SeveralIteration(2))
+    # "crash" at step 6: mid-epoch 1 (epochs are 4 steps at bs=16)
+    t_crash.fit(ds, batch_size=16, end_trigger=triggers.MaxIteration(6))
+
+    monkeypatch.setenv("ZOO_RESUME", "1")
+    t_res = make_trainer()
+    t_res.set_checkpoint(ckpt, trigger=triggers.SeveralIteration(2))
+    t_res.fit(ds, batch_size=16, end_trigger=triggers.MaxEpoch(3))
+    assert t_res.state.step == t_full.state.step == 12
+    for (pa, la), (pb, lb) in zip(
+            jax.tree_util.tree_flatten_with_path(t_full.state.params)[0],
+            jax.tree_util.tree_flatten_with_path(t_res.state.params)[0]):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), pa
+
+
+def test_resume_at_epoch_boundary_with_verbose(tmp_path, monkeypatch,
+                                               capsys):
+    """An iteration-trigger checkpoint landing exactly on an epoch
+    boundary (epoch_step == steps-per-epoch) replays an EMPTY first
+    epoch on resume — fit must handle it (verbose included: the epoch
+    record's loss is None) and still finish bit-identical."""
+    import optax
+    import jax
+    from analytics_zoo_tpu.data.dataset import Dataset
+    from analytics_zoo_tpu.train import triggers
+    from analytics_zoo_tpu.train.trainer import Trainer
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+
+    zoo.init_nncontext()
+    x, y = make_data(64)
+    ds = Dataset.from_ndarray(x, y)
+
+    def make_trainer():
+        return Trainer(
+            build_mlp().to_graph(),
+            objectives.get("sparse_categorical_crossentropy"),
+            optax.sgd(0.1, momentum=0.9), seed=0)
+
+    t_full = make_trainer()
+    t_full.fit(ds, batch_size=16, end_trigger=triggers.MaxEpoch(2))
+
+    ckpt = str(tmp_path / "ckpt")
+    monkeypatch.setenv("ZOO_CKPT_SYNC", "1")
+    t_crash = make_trainer()
+    t_crash.set_checkpoint(ckpt, trigger=triggers.SeveralIteration(4))
+    # stop at step 4 == the exact end of epoch 0 (4 steps/epoch)
+    t_crash.fit(ds, batch_size=16, end_trigger=triggers.MaxIteration(4))
+
+    monkeypatch.setenv("ZOO_RESUME", "1")
+    t_res = make_trainer()
+    t_res.set_checkpoint(ckpt, trigger=triggers.SeveralIteration(4))
+    t_res.fit(ds, batch_size=16, end_trigger=triggers.MaxEpoch(2),
+              verbose=True)
+    assert "loss n/a" in capsys.readouterr().out
+    assert t_res.state.step == t_full.state.step == 8
+    for la, lb in zip(jax.tree_util.tree_leaves(t_full.state.params),
+                      jax.tree_util.tree_leaves(t_res.state.params)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_resume_on_torn_first_save_is_cold_start(tmp_path, monkeypatch):
+    """A crash during the FIRST-ever save leaves a commit-less,
+    legacy-looking directory whose torn tag cannot restore — the
+    ZOO_RESUME path must cold-start (and keep training), never
+    crash-loop the resumed incarnation."""
+    import json
+    import optax
+    from analytics_zoo_tpu.data.dataset import Dataset
+    from analytics_zoo_tpu.train import triggers
+    from analytics_zoo_tpu.train.trainer import Trainer
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+
+    zoo.init_nncontext()
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    # torn first save: rank 0's shard + a manifest claiming 2 writers,
+    # rank 1's shard missing, no commit manifest anywhere
+    np.savez(str(ckpt / "ckpt_2.shard-p0.npz"),
+             **{"0|0:4,0:4": np.ones((4, 4), np.float32)})
+    (ckpt / "ckpt_2.json").write_text(json.dumps(
+        {"format": "sharded", "tag": "2", "meta": {"step": 2},
+         "n_processes": 2, "names": ["w"], "shapes": [[4, 4]],
+         "dtypes": ["float32"]}))
+    monkeypatch.setenv("ZOO_RESUME", "1")
+    x, y = make_data(32)
+    t = Trainer(build_mlp().to_graph(),
+                objectives.get("sparse_categorical_crossentropy"),
+                optax.sgd(0.1), seed=0)
+    t.set_checkpoint(str(ckpt))
+    t.fit(Dataset.from_ndarray(x, y), batch_size=16,
+          end_trigger=triggers.MaxEpoch(1))
+    assert t.state.epoch == 1 and t.state.step == 2
+
+
+def test_resume_env_without_checkpoint_is_cold_start(tmp_path,
+                                                     monkeypatch):
+    """ZOO_RESUME with an empty checkpoint dir must train from scratch
+    (clean cold start), not fail."""
+    import optax
+    from analytics_zoo_tpu.data.dataset import Dataset
+    from analytics_zoo_tpu.train import triggers
+    from analytics_zoo_tpu.train.trainer import Trainer
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+
+    zoo.init_nncontext()
+    x, y = make_data(32)
+    monkeypatch.setenv("ZOO_RESUME", "1")
+    t = Trainer(build_mlp().to_graph(),
+                objectives.get("sparse_categorical_crossentropy"),
+                optax.sgd(0.1), seed=0)
+    t.set_checkpoint(str(tmp_path / "empty"))
+    t.fit(Dataset.from_ndarray(x, y), batch_size=16,
+          end_trigger=triggers.MaxEpoch(1))
+    assert t.state.epoch == 1 and t.state.step == 2
+
+
 def test_prefetch_iterator_order_and_completeness():
     items = list(range(17))
     out = list(prefetch_iterator(iter(items), lambda v: v * 2, depth=3))
