@@ -28,8 +28,13 @@ immediately (no survivor is ever left blocked in a collective until
 timeout) and, within ``--max-restarts``, relaunches it with
 ``ZOO_RESUME=1`` so a checkpointing ``Trainer.fit`` resumes from the
 newest complete snapshot.  Restarts back off exponentially from
-``--restart-backoff``.  See ``train/faults.py`` for the full worker-side
-env contract and ``docs/distributed-training.md`` for the semantics.
+``--restart-backoff``.  Every crash/watchdog incident additionally
+harvests the workers' flight recorders (``ZOO_FLIGHTREC_DIR``,
+exported per worker) into a ``pod_postmortem.json`` + aggregated
+``pod_metrics.prom`` in the run directory — preserved even when the
+pod recovers — so "why did rank 1 die" survives the reap.  See
+``train/faults.py`` for the full worker-side env contract and
+``docs/distributed-training.md`` for the semantics.
 
 Examples:
   zoo-tpu-submit train.py --epochs 10
@@ -55,6 +60,7 @@ import tempfile
 import time
 from typing import List, Optional, Tuple
 
+from .observability import flightrec
 from .parallel.distributed import ENV_COORD, ENV_NPROC, ENV_PID
 from .train import faults
 from .train import metrics as train_metrics
@@ -152,6 +158,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     return _run_supervised(args)
 
 
+def _flight_dir(run_dir: str) -> str:
+    """The pod's shared flight-recorder directory: a pre-set
+    ``ZOO_FLIGHTREC_DIR`` wins (drills harvest it themselves),
+    otherwise it lives with the other supervision artifacts."""
+    return (os.environ.get(flightrec.ENV_DIR)
+            or os.path.join(run_dir, "flightrec"))
+
+
 def _spawn_pod(args, coordinator: str, run_dir: str, incarnation: int,
                resume: bool) -> Tuple[list, List[str], List[str]]:
     """Launch all worker processes of one pod incarnation.  Worker
@@ -163,6 +177,10 @@ def _spawn_pod(args, coordinator: str, run_dir: str, incarnation: int,
         env[ENV_COORD] = coordinator
         env[ENV_NPROC] = str(args.num_processes)
         env[ENV_PID] = str(pid)
+        # every worker records its black box under the shared pod dir;
+        # _reap_pod's postmortem harvests it (observability/flightrec)
+        env[flightrec.ENV_DIR] = _flight_dir(run_dir)
+        env[faults.ENV_RESTART_COUNT] = str(incarnation)
         # local fan-out defaults to CPU workers — an inherited TPU
         # platform (e.g. a tunnel plugin) must not leak into the
         # simulated pod
@@ -182,7 +200,6 @@ def _spawn_pod(args, coordinator: str, run_dir: str, incarnation: int,
         hb_paths.append(hb)
         if resume:
             env[faults.ENV_RESUME] = "1"
-            env[faults.ENV_RESTART_COUNT] = str(incarnation)
         err = os.path.join(run_dir, f"stderr_p{pid}.r{incarnation}.log")
         err_paths.append(err)
         with open(err, "wb") as errf:
@@ -283,10 +300,11 @@ def _run_supervised(args) -> int:
     run_dir = tempfile.mkdtemp(prefix="zoo-pod-")
     coordinator = args.coordinator or f"localhost:{_free_port()}"
     reasons: List[str] = []
+    postmortems: List[str] = []
     rc = 1
     try:
         rc = _supervision_loop(args, slog, run_dir, coordinator,
-                               reasons)
+                               reasons, postmortems)
     finally:
         restarts = sum(1 for r in reasons if r in ("exit", "watchdog"))
         port_retries = reasons.count("port")
@@ -295,18 +313,69 @@ def _run_supervised(args) -> int:
                 json.dump({"rc": rc, "restarts": restarts,
                            "port_retries": port_retries,
                            "reasons": reasons,
+                           "postmortems": postmortems,
                            "metrics": train_metrics.snapshot()}, f)
-        if rc == 0:
+        if rc == 0 and not postmortems:
             shutil.rmtree(run_dir, ignore_errors=True)
         else:
-            # keep heartbeat/stderr artifacts for the postmortem
+            # keep heartbeat/stderr/flight-recorder artifacts: even a
+            # run that RECOVERED to rc 0 had an incident worth reading
             slog.info("supervision artifacts kept", run_dir=run_dir,
-                      rc=rc)
+                      rc=rc, postmortems=postmortems)
     return rc
 
 
+def _write_pod_postmortem(run_dir: str, outcome: str,
+                          rank: Optional[int], incarnation: int,
+                          procs: list, hb_ages: dict, slog,
+                          stale_ranks: Optional[List[int]] = None
+                          ) -> Optional[str]:
+    """Harvest every worker's flight recorder and land the pod
+    post-mortem: per-rank last steps, heartbeat timelines, final spans
+    and log tails (flightrec.write_postmortem), merged with the
+    supervisor-side evidence only it has — exit codes and
+    heartbeat-file ages at reap time.  Also writes the aggregated
+    pod-level scrape (``pod_metrics.prom``) beside it.  Best-effort:
+    a postmortem failure must never eat the restart itself."""
+    supervisor = {
+        r: {"rc": p.returncode, "heartbeat_age_s": hb_ages.get(r)}
+        for r, p in enumerate(procs)}
+    path = os.path.join(run_dir, f"pod_postmortem.i{incarnation}.json")
+    latest = os.path.join(run_dir, "pod_postmortem.json")
+    try:
+        pm = flightrec.write_postmortem(
+            _flight_dir(run_dir), path, reason=outcome,
+            failed_rank=rank, incarnation=incarnation,
+            supervisor=supervisor,
+            # a hung collective stalls EVERY participant's heartbeat;
+            # the convicted rank is whichever the watchdog found first
+            # — the full stale set is the honest evidence
+            extra=({"stale_ranks": stale_ranks}
+                   if stale_ranks is not None else None))
+        flightrec.atomic_write(latest,
+                               json.dumps(pm, indent=2, default=str))
+    except Exception as e:
+        slog.error("could not write pod postmortem", run_dir=run_dir,
+                   error=f"{type(e).__name__}: {e}")
+        return None
+    try:
+        from .observability import aggregate as _aggregate
+        flightrec.atomic_write(
+            os.path.join(run_dir, "pod_metrics.prom"),
+            _aggregate.aggregate_dir(_flight_dir(run_dir)))
+    except Exception:
+        pass  # no snapshots yet is a legal postmortem state
+    failed = pm.get("ranks", {}).get(str(rank), {})
+    slog.error("pod postmortem written", path=path, reason=outcome,
+               failed_rank=rank,
+               last_step=failed.get("last_step"),
+               heartbeat_age_s=failed.get("heartbeat_age_s"))
+    return path
+
+
 def _supervision_loop(args, slog, run_dir: str, coordinator: str,
-                      reasons: List[str]) -> int:
+                      reasons: List[str],
+                      postmortems: Optional[List[str]] = None) -> int:
     restarts = 0
     port_retries = 0
     incarnation = 0
@@ -330,6 +399,23 @@ def _supervision_loop(args, slog, run_dir: str, coordinator: str,
             _replay_stderr(err_paths)
             rc = 0
             break
+        # heartbeat-file ages sampled at detection time — reaping takes
+        # up to the grace window and must not skew the postmortem.
+        # stale_ranks = LIVE workers past the watchdog window (a hung
+        # collective stalls every participant; an already-exited
+        # worker's aging file is not a hang)
+        now = time.time()
+        hb_ages = {}
+        stale_ranks = []
+        for r, hb in enumerate(hb_paths):
+            try:
+                hb_ages[r] = round(now - os.path.getmtime(hb), 3)
+            except OSError:
+                hb_ages[r] = None  # worker died before its first beat
+            if (outcome == "watchdog" and procs[r].poll() is None
+                    and hb_ages[r] is not None
+                    and hb_ages[r] > args.watchdog_sec):
+                stale_ranks.append(r)
         failed_rc = procs[rank].returncode if outcome == "exit" else None
         _reap_pod(procs, grace_s=5.0,
                   kill_first=rank if outcome == "watchdog" else None)
@@ -350,6 +436,15 @@ def _supervision_loop(args, slog, run_dir: str, coordinator: str,
                          "on a fresh port", retry=port_retries,
                          coordinator=coordinator)
             continue
+        # a real incident (crash or hang, not a bind race): harvest the
+        # black boxes NOW — the next incarnation reuses the directory
+        # namespace and a budget-exhausted exit must still explain itself
+        pm = _write_pod_postmortem(
+            run_dir, outcome, rank, incarnation - 1, procs, hb_ages,
+            slog,
+            stale_ranks=stale_ranks if outcome == "watchdog" else None)
+        if pm and postmortems is not None:
+            postmortems.append(pm)
         if restarts >= args.max_restarts:
             slog.error("pod failed and the restart budget is exhausted",
                        reason=outcome, rank=rank, rc=failed_rc,

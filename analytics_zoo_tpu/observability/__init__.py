@@ -14,21 +14,32 @@ Three halves, importable with zero jax cost (jax loads lazily inside
 
 Plus :mod:`.log` — the structured JSON logger with request-id
 correlation (the ZL601-sanctioned replacement for ``print``/stdlib
-``logging`` on hot paths).
+``logging`` on hot paths), now auto-stamping ``rank``/``incarnation``
+from the supervisor env contract.
+
+Cross-process (this PR's layer): :mod:`.flightrec` — the crash-safe
+per-process flight recorder the supervising launcher harvests into
+``pod_postmortem.json`` after reaping a worker — and :mod:`.aggregate`
+— per-rank Prometheus snapshots merged into one pod-level scrape
+(``python -m analytics_zoo_tpu.observability.aggregate``).
 
 See docs/observability.md for the span taxonomy and wiring examples.
 """
 
-from . import profile, trace
+from . import aggregate, flightrec, profile, trace
+from .flightrec import FlightRecorder
 from .log import StructuredLogger, get_logger
 from .metrics import (Counters, Family, LatencyWindow, MetricsRegistry,
-                      parse_prometheus_text, render_prometheus,
-                      summary_family)
-from .trace import PHASES, Span, Tracer, activate, current_span
+                      parse_prometheus_text, process_info_family,
+                      render_prometheus, summary_family)
+from .trace import (PHASES, TRAIN_PHASES, Span, Tracer, activate,
+                    current_span)
 
 __all__ = [
-    "Counters", "Family", "LatencyWindow", "MetricsRegistry", "PHASES",
-    "Span", "StructuredLogger", "Tracer", "activate", "current_span",
-    "get_logger", "parse_prometheus_text", "profile",
-    "render_prometheus", "summary_family", "trace",
+    "Counters", "Family", "FlightRecorder", "LatencyWindow",
+    "MetricsRegistry", "PHASES", "Span", "StructuredLogger",
+    "TRAIN_PHASES", "Tracer", "activate", "aggregate", "current_span",
+    "flightrec", "get_logger", "parse_prometheus_text",
+    "process_info_family", "profile", "render_prometheus",
+    "summary_family", "trace",
 ]

@@ -21,8 +21,10 @@ Three layers, smallest first:
 from __future__ import annotations
 
 import math
+import os
 import re
 import threading
+import time
 from collections import deque
 from typing import (Any, Callable, Dict, Iterable, List, Optional,
                     Sequence, Tuple)
@@ -258,14 +260,61 @@ class _LabeledFamily:
                       [(c.labels, c.get()) for c in children])
 
 
+_PROCESS_START_UNIX = round(time.time(), 3)
+_versions_cache: Optional[Dict[str, str]] = None
+
+
+def _runtime_versions() -> Dict[str, str]:
+    """jax/jaxlib versions, resolved lazily ONCE (importing jax at
+    scrape time is free when the process already did; a jax-free
+    process reports "none")."""
+    global _versions_cache
+    if _versions_cache is None:
+        v = {"jax": "none", "jaxlib": "none"}
+        try:
+            import jax
+            import jaxlib
+            v = {"jax": jax.__version__, "jaxlib": jaxlib.__version__}
+        except Exception:
+            pass
+        _versions_cache = v
+    return _versions_cache
+
+
+def process_info_family() -> Family:
+    """``zoo_process_info`` — the info-gauge (constant 1, identity in
+    the labels) every process exports by default: pid, distributed rank
+    and supervisor incarnation (the PR 10 env contract), jax/jaxlib
+    versions, and process start time.  The pod aggregator joins
+    per-rank scrapes on it; a fleet debugger greps it first."""
+    versions = _runtime_versions()
+    labels = {
+        "pid": str(os.getpid()),
+        "rank": os.environ.get("ZOO_TPU_PROCESS_ID")
+        or os.environ.get("JAX_PROCESS_ID") or "0",
+        "incarnation": os.environ.get("ZOO_RESTART_COUNT") or "0",
+        "jax": versions["jax"],
+        "jaxlib": versions["jaxlib"],
+        "start_unix": str(_PROCESS_START_UNIX),
+    }
+    return Family("gauge", "zoo_process_info",
+                  "process identity info-gauge (labels carry the data)",
+                  [(labels, 1.0)])
+
+
 class MetricsRegistry:
     """The process-wide metric surface: owned counter/gauge families
-    plus scrape-time collectors (module docstring)."""
+    plus scrape-time collectors (module docstring).  Every registry
+    exports ``zoo_process_info`` by default (``process_info=False``
+    opts out) — the aggregator's join key must exist before anyone
+    thinks to register it."""
 
-    def __init__(self):
+    def __init__(self, process_info: bool = True):
         self._lock = threading.Lock()
         self._families: Dict[str, _LabeledFamily] = {}
         self._collectors: List[Callable[[], Iterable[Family]]] = []
+        if process_info:
+            self._collectors.append(lambda: [process_info_family()])
 
     def counter(self, name: str, help: str = "") -> _LabeledFamily:
         return self._family("counter", name, help)

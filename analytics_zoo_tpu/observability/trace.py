@@ -51,6 +51,13 @@ from .metrics import Family
 PHASES = ("admission_queue", "coalesce_wait", "pad", "device_put",
           "execute", "depad", "decode_wait", "prefill", "decode_step")
 
+#: the training-step phase order (train/stepprof.py; same gap-free
+#: discipline as the request chain): waiting on the prefetch queue,
+#: the host->device upload (measured on the prefetch thread and
+#: attributed to the consuming step), the compiled step dispatch, and
+#: the checkpoint save when its trigger fires.
+TRAIN_PHASES = ("data_wait", "h2d", "step_compute", "ckpt_save")
+
 _SPAN_VAR: "contextvars.ContextVar[Optional[Span]]" = \
     contextvars.ContextVar("zoo_tpu_span", default=None)
 # STICKY enable flag: False until the first span is ever activated in
@@ -108,6 +115,17 @@ def new_trace_id() -> str:
     return f"{_ID_PREFIX}{next(_ID_COUNTER) & 0xffffffff:08x}"
 
 
+# finished-span sink for the flight recorder (flightrec.configure):
+# every tracer-owned span that finishes is offered to it.  One None
+# check per finish when no recorder is configured.
+_FINISH_HOOK: "Optional[Any]" = None
+
+
+def set_finish_hook(fn) -> None:
+    global _FINISH_HOOK
+    _FINISH_HOOK = fn
+
+
 class Span:
     """One request's timeline: ordered phases + point events + labels.
 
@@ -149,6 +167,16 @@ class Span:
         if self._open is not None:
             self._open[2] = time.perf_counter()
             self._open = None
+
+    def phase_add(self, name: str, seconds: float,
+                  end_s: Optional[float] = None):
+        """Record an already-measured CLOSED phase (duration known, no
+        open/close bracketing).  For work measured on another thread —
+        the prefetch thread's h2d upload — whose duration belongs in
+        this span's totals but whose wall interval overlaps the
+        on-thread phases."""
+        end = time.perf_counter() if end_s is None else end_s
+        self.phases.append([name, end - seconds, end])
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -278,6 +306,12 @@ class Tracer:
                     agg[0] += 1
                     agg[1] += dur
                     agg[2] = max(agg[2], dur)
+        hook = _FINISH_HOOK  # outside the lock: the hook does file I/O
+        if hook is not None:
+            try:
+                hook(span)
+            except Exception:
+                pass  # the flight recorder must never fail a request
 
     # ---- read side ----
     @property

@@ -62,7 +62,14 @@ DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        # supervision poll loop and the per-step worker
                        # heartbeat both sit on latency-critical paths
                        # (detection latency / the training step)
-                       "_supervise", "heartbeat")
+                       "_supervise", "heartbeat",
+                       # cross-process observability: the flight
+                       # recorder's framed append runs once per
+                       # training step (and per finished span), and
+                       # the aggregator merge loop runs per pod scrape
+                       # — a stray sync or free-text log in either
+                       # taxes every step / every scrape
+                       "_append", "merge_snapshots")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

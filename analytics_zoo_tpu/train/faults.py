@@ -94,6 +94,10 @@ def refresh() -> None:
     _hb_path = os.environ.get(ENV_HEARTBEAT) or None
     _crash_step = None
     _hang_step = None
+    # the structured logger stamps rank/incarnation from the same env
+    # contract; re-read it alongside (jax-free import)
+    from ..observability import log as _log
+    _log.refresh_identity()
     if resume_requested():
         return  # fault hooks are one-shot: disarmed on a resumed pod
     rank = _rank()

@@ -9,6 +9,10 @@ stack uses — wire them into any registry with::
 
 Families:
 
+``zoo_train_steps_total``
+    Worker-side: completed ``Trainer.fit`` steps.  The pod
+    aggregator's join/straggler key: per-rank series sum to the pod
+    total in the aggregated scrape (observability/aggregate.py).
 ``zoo_train_restarts_total{reason}``
     Supervisor-side: pod relaunches, by reason (``exit`` — a worker
     exited nonzero; ``watchdog`` — a heartbeat went stale and the
@@ -38,11 +42,21 @@ _restarts: Dict[str, int] = {}
 _saves: Dict[str, int] = {}
 _restores: Dict[str, int] = {}
 _commits: int = 0
+_steps: int = 0
 
 
 def record_restart(reason: str) -> None:
     with _lock:
         _restarts[reason] = _restarts.get(reason, 0) + 1
+
+
+def record_step() -> None:
+    """One completed training step (worker-side, per ``Trainer.fit``
+    iteration).  The pod aggregator's straggler view and its
+    sum-to-pod-total gate both key on this counter."""
+    global _steps
+    with _lock:
+        _steps += 1
 
 
 def record_ckpt_save(fmt: str) -> None:
@@ -64,23 +78,30 @@ def record_ckpt_restore(outcome: str) -> None:
 def snapshot() -> Dict[str, Dict[str, int]]:
     with _lock:
         return {"restarts": dict(_restarts), "ckpt_saves": dict(_saves),
-                "ckpt_commits": _commits, "ckpt_restores": dict(_restores)}
+                "ckpt_commits": _commits, "ckpt_restores": dict(_restores),
+                "steps": _steps}
 
 
 def reset() -> None:
     """Test isolation hook."""
-    global _commits
+    global _commits, _steps
     with _lock:
         _restarts.clear()
         _saves.clear()
         _restores.clear()
         _commits = 0
+        _steps = 0
 
 
 def train_families() -> List[Family]:
     """Current counters as exposition families (a registry collector)."""
     with _lock:
         fams = []
+        if _steps:
+            fams.append(Family(
+                "counter", "zoo_train_steps_total",
+                "Completed training steps in this process",
+                [({}, _steps)]))
         if _restarts:
             fams.append(Family(
                 "counter", "zoo_train_restarts_total",

@@ -33,11 +33,14 @@ import optax
 from ..common.utils import pad_leading
 from ..data.dataset import (Dataset, check_batch_divisibility,
                             prefetch_iterator, shard_batch)
+from ..observability import flightrec
+from ..observability import trace as trace_lib
 from ..parallel import distributed as dist_lib
 from ..parallel import mesh as mesh_lib
 from ..parallel import sharding as sharding_lib
 from . import faults
 from . import metrics as train_metrics
+from . import stepprof
 from . import triggers as trigger_lib
 from .checkpoint import async_save_sharded, save_sharded
 from .checkpoint import wait_pending as checkpoint_lib_wait_pending
@@ -420,6 +423,19 @@ class Trainer:
     _ckpt_trigger = None
     _auto_resumed = False
     _resume_epoch_step = 0
+    _step_profiler: "Optional[stepprof.StepProfiler]" = None
+
+    def enable_step_profiler(self, timeline_path: Optional[str] = None
+                             ) -> "stepprof.StepProfiler":
+        """Turn on the per-step phase profiler (data_wait -> h2d ->
+        step_compute -> ckpt_save; train/stepprof.py) for subsequent
+        ``fit`` calls.  ``timeline_path`` additionally publishes the
+        bounded per-step timeline as JSONL at fit end.  Also reachable
+        without code changes via ``ZOO_STEP_PROFILE=1`` /
+        ``ZOO_STEP_TIMELINE=<path>``."""
+        self._step_profiler = stepprof.StepProfiler(
+            timeline_path=timeline_path)
+        return self._step_profiler
 
     def _maybe_auto_resume(self):
         """Supervised-restart contract: under ``ZOO_RESUME`` (set by the
@@ -477,6 +493,21 @@ class Trainer:
         self.ensure_initialized()
         faults.refresh()  # supervisor env contract (heartbeat/faults)
         faults.heartbeat()
+        # cross-process observability: the flight recorder (black box
+        # the supervisor harvests on abnormal exit) and the step
+        # profiler both arm from the env contract; each costs one None
+        # check per step when absent
+        recorder = flightrec.install_from_env()
+        prof = self._step_profiler
+        if prof is None:
+            prof = self._step_profiler = stepprof.from_env()
+        if recorder is not None:
+            # add_collector dedups by function identity, so wiring on
+            # every fit is free AND survives a recorder being replaced
+            # (shutdown + re-configure) between fits
+            recorder.add_collector(train_metrics.train_families)
+            if prof is not None:
+                recorder.add_collector(prof.families)
         self._maybe_auto_resume()
         # mid-epoch resume (iteration-trigger checkpoints): skip the
         # batches the restored position already consumed so the replayed
@@ -542,15 +573,56 @@ class Trainer:
                     batch_it = itertools.islice(batch_it, resume_skip,
                                                 None)
                     resume_skip = 0
-                dev_it = prefetch_iterator(batch_it,
-                                           lambda b: self._put_batch(*b))
-                for bx, by in dev_it:
+                if prof is None:
+                    put_fn = lambda b: self._put_batch(*b)
+                else:
+                    def put_fn(b):
+                        # h2d measured ON the prefetch thread, shipped
+                        # with the batch so the consuming step's span
+                        # can attribute it
+                        t0 = time.perf_counter()
+                        out = self._put_batch(*b)
+                        return out, time.perf_counter() - t0
+                dev_it = prefetch_iterator(batch_it, put_fn)
+                step_it = (dev_it if prof is None
+                           else prof.timed_iter(dev_it))
+                for item in step_it:
+                    if prof is None:
+                        bx, by = item
+                        span = None
+                    else:
+                        (bx, by), h2d_s = item
+                        span = prof.begin_step(st.step + 1, h2d_s)
                     step_rng = jax.random.fold_in(st.rng, st.step)
-                    st.params, st.model_state, st.opt_state, loss = \
-                        self._train_step(st.params, st.model_state,
-                                         st.opt_state, step_rng, bx, by)
+                    if span is None:
+                        st.params, st.model_state, st.opt_state, loss = \
+                            self._train_step(st.params, st.model_state,
+                                             st.opt_state, step_rng,
+                                             bx, by)
+                    else:
+                        # the span is ACTIVE across the dispatch so
+                        # backend_compile events attribute to the exact
+                        # step that paid the compile
+                        span.phase_start("step_compute")
+                        with trace_lib.activate(span):
+                            st.params, st.model_state, st.opt_state, \
+                                loss = self._train_step(
+                                    st.params, st.model_state,
+                                    st.opt_state, step_rng, bx, by)
+                        span.phase_end()
                     st.step += 1
                     faults.heartbeat()
+                    train_metrics.record_step()
+                    if recorder is not None:
+                        # liveness marker BEFORE the fault hook: a
+                        # crash at step k must leave the step-k record
+                        # (the postmortem's "last completed step")
+                        recorder.record_step(st.step)
+                        if not st.step & 15:
+                            # throttle-CHECK every 16th step: the call
+                            # itself is measurable in a contended loop
+                            # and the snapshot cadence is seconds
+                            recorder.snapshot_metrics()
                     # injected faults land BEFORE the checkpoint trigger:
                     # a crash at step k must never leave a step-k tag
                     faults.maybe_fault(st.step)
@@ -564,12 +636,18 @@ class Trainer:
                     if self._ckpt_path and not isinstance(
                             self._ckpt_trigger, trigger_lib.EveryEpoch) \
                             and self._ckpt_trigger(it_record):
+                        if span is not None:
+                            span.phase_start("ckpt_save")
                         save = (save_sharded if faults.sync_checkpoints()
                                 else async_save_sharded)
                         save(self._ckpt_path, st.step, st.as_tree(),
                              meta={"step": st.step, "epoch": st.epoch,
                                    "epoch_step":
                                        st.step - epoch_start_step})
+                        if span is not None:
+                            span.phase_end()
+                    if span is not None:
+                        prof.finish_step(span, st.step)
                     if end_trigger(it_record):
                         # remember the firing so the outer loop terminates even
                         # for triggers the outer record can't re-evaluate
@@ -635,6 +713,18 @@ class Trainer:
             # profiling stays broken for the process ('trace already
             # started')
             _stop_profile()
+            if prof is not None:
+                prof.flush(recorder)  # buffered step entries
+                try:
+                    prof.write_timeline()
+                except OSError as e:
+                    from ..observability.log import get_logger
+                    get_logger("analytics_zoo_tpu.train").warning(
+                        "could not write step timeline",
+                        path=prof.timeline_path,
+                        error=f"{type(e).__name__}: {e}")
+            if recorder is not None:
+                recorder.snapshot_metrics(force=True)
         if self._ckpt_path:
             # fit returning means "checkpoints are on disk" — join the
             # async writers, then barrier so EVERY pod process's shards

@@ -26,6 +26,11 @@ grep -q "corrupt_discarded=True" <<<"$ft" || {
          "convicted and discarded at restore" >&2
     exit 1
 }
+grep -q "POSTMORTEM_OK" <<<"$ft" || {
+    echo "smoke FAIL: the crash leg did not produce a pod_postmortem" \
+         "naming the failed rank / last step / heartbeat age" >&2
+    exit 1
+}
 grep -q "FAULTTRAIN_SELFCHECK_OK" <<<"$ft" || {
     echo "smoke FAIL: faulttrain selfcheck gates failed" >&2
     exit 1

@@ -237,8 +237,11 @@ def test_prometheus_render_parse_round_trip_with_escaping():
     parsed = parse_prometheus_text(text)  # must not raise
     assert parsed["samples"][
         ("zoo_reqs_total", (("model", nasty),))] == 7.0
+    # zoo_process_info rides every registry by default (aggregation
+    # join key); the owned families keep their exact types
     assert parsed["types"] == {"zoo_reqs_total": "counter",
-                               "zoo_nan_gauge": "gauge"}
+                               "zoo_nan_gauge": "gauge",
+                               "zoo_process_info": "gauge"}
     # collector families merge into the same scrape
     reg.register_collector(lambda: [Family(
         "counter", "zoo_extra_total", "", [({"k": "v"}, 1)])])

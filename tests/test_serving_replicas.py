@@ -624,16 +624,23 @@ def test_failed_probe_doubles_backoff():
     with rs._lock:
         sick.probe_backoff = rs.probe_backoff_s = 0.01
     seen = []
-    for _ in range(3):
+    for round_i in range(3):
         prev = sick.probe_backoff
-        with rs._lock:
-            sick.probe_at = 0.0
-        rs.maybe_reprobe()
-        # probes run on a detached daemon thread now — wait for it
-        deadline = time.monotonic() + 5.0
+        # poll with a deadline, RETRYING the reprobe ask each pass: on
+        # a loaded 2-core box the detached probe thread from the
+        # previous round can still hold the probe guard, in which case
+        # a single maybe_reprobe() call is a silent no-op and a fixed
+        # wait misses the whole backoff window (flaked in PR 10's
+        # full-suite runs)
+        deadline = time.monotonic() + 30.0
         while time.monotonic() < deadline \
                 and sick.probe_backoff == prev and not sick.healthy:
+            with rs._lock:
+                sick.probe_at = 0.0
+            rs.maybe_reprobe()
             time.sleep(0.005)
+        assert sick.probe_backoff > prev, \
+            f"round {round_i}: no probe ran within the deadline {seen}"
         seen.append(sick.probe_backoff)
         assert not sick.healthy  # the crasher is still installed
     assert seen[0] < seen[1] < seen[2], seen  # doubling, not constant

@@ -40,6 +40,29 @@ WORKER = textwrap.dedent("""
 
     if mode == "crash" and rank == 1 and not resume:
         sys.exit(3)
+    if mode == "crashrec":
+        # a worker WITH a flight recorder: append real framed records
+        # (stdlib only — this pins the on-disk framing cross-
+        # implementation) + an atomic metric snapshot, then rank 1
+        # dies mid-write leaving a torn tail frame
+        import struct, zlib, json as _json
+        base = os.environ["ZOO_FLIGHTREC_DIR"]
+        inc = os.environ.get("ZOO_RESTART_COUNT", "0")
+        d = os.path.join(base, f"rank{rank}.i{inc}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "events.seg"), "ab") as f:
+            for step in range(1, 7):
+                p = _json.dumps({"t": "hb", "ts": time.time(),
+                                 "step": step}).encode()
+                f.write(struct.pack("<II", len(p),
+                                    zlib.crc32(p) & 0xffffffff) + p)
+            f.write(struct.pack("<II", 64, 1234) + b"half")  # torn
+        with open(os.path.join(d, "metrics.prom"), "w") as f:
+            f.write("# TYPE zoo_train_steps_total counter\\n")
+            f.write("zoo_train_steps_total 6\\n")
+        if rank == 1 and not resume:
+            time.sleep(0.5)  # let rank 0 land its snapshot first
+            sys.exit(5)
     if mode == "hang" and rank == 1 and not resume:
         beat()
         time.sleep(300)
@@ -83,6 +106,14 @@ def _launch(tmp_path, mode, extra_args=(), timeout=120):
     return proc, summ
 
 
+def _cleanup_kept(summ):
+    """Reap the supervision run_dir the launcher preserves once a
+    postmortem was written (tests read it first, then clean up)."""
+    import shutil
+    for p in (summ or {}).get("postmortems", []):
+        shutil.rmtree(os.path.dirname(p), ignore_errors=True)
+
+
 def test_crash_restarts_with_resume_env(tmp_path):
     """A worker exiting nonzero tears the pod down and relaunches it
     with ZOO_RESUME=1 within the --max-restarts budget."""
@@ -93,6 +124,7 @@ def test_crash_restarts_with_resume_env(tmp_path):
     assert "DONE rank=0 resume=1 restart_count=1" in proc.stdout
     assert "DONE rank=1 resume=1" in proc.stdout
     assert summ["metrics"]["restarts"] == {"exit": 1}
+    _cleanup_kept(summ)
 
 
 def test_partial_death_fast_fails_with_no_restarts(tmp_path):
@@ -117,16 +149,36 @@ def test_watchdog_kills_and_restarts_hung_worker(tmp_path):
     assert summ["reasons"] == ["watchdog"], summ
     assert "DONE rank=1 resume=1" in proc.stdout
     assert summ["metrics"]["restarts"] == {"watchdog": 1}
+    _cleanup_kept(summ)
 
 
 def test_restart_budget_exhaustion_fails(tmp_path):
     """A pod that keeps crashing past the budget surfaces the failure
     rc instead of looping forever (the crash mode only crashes the
-    FIRST incarnation, so --max-restarts 0 must fail)."""
+    FIRST incarnation, so --max-restarts 0 must fail) — and the
+    incident still gets its postmortem: supervisor-side evidence
+    (failed rank, exit rc, heartbeat age) must be present even though
+    these fake workers never wrote a flight-recorder record."""
     proc, summ = _launch(tmp_path, "crash")
     assert proc.returncode == 3
     assert summ == {"rc": 3, "restarts": 0, "port_retries": 0,
-                    "reasons": [], "metrics": summ["metrics"]}
+                    "reasons": [], "postmortems": summ["postmortems"],
+                    "metrics": summ["metrics"]}
+    assert len(summ["postmortems"]) == 1
+    with open(summ["postmortems"][0]) as f:
+        pm = json.load(f)
+    assert pm["reason"] == "exit" and pm["failed_rank"] == 1
+    assert pm["ranks"]["1"]["rc"] == 3
+    # rank 1 exited before ever heartbeating; rank 0 finished clean
+    assert pm["ranks"]["1"]["heartbeat_age_s"] is None
+    assert pm["ranks"]["0"]["heartbeat_age_s"] is not None
+    # the run_dir is preserved alongside for humans
+    latest = os.path.join(os.path.dirname(summ["postmortems"][0]),
+                          "pod_postmortem.json")
+    assert os.path.exists(latest)
+    import shutil
+    shutil.rmtree(os.path.dirname(summ["postmortems"][0]),
+                  ignore_errors=True)
 
 
 def test_coordinator_bind_race_retried_with_fresh_port(tmp_path):
@@ -139,6 +191,61 @@ def test_coordinator_bind_race_retried_with_fresh_port(tmp_path):
     assert summ["port_retries"] == 1 and summ["restarts"] == 0
     assert summ["reasons"] == ["port"]
     assert "DONE rank=0 resume=0" in proc.stdout
+
+
+def test_postmortem_harvests_flight_recorders(tmp_path):
+    """The reaped pod's postmortem answers "why did rank 1 die":
+    harvested flight-recorder heartbeats name the last completed step
+    (the torn tail frame the kill left is dropped, never misread), the
+    supervisor contributes the exit rc and heartbeat age, and the
+    aggregated pod scrape lands beside it with per-rank step counters
+    summing to the pod total."""
+    import shutil
+    from analytics_zoo_tpu.observability.metrics import \
+        parse_prometheus_text
+    proc, summ = _launch(tmp_path, "crashrec", ["--max-restarts", "1"])
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    assert len(summ["postmortems"]) == 1
+    run_dir = os.path.dirname(summ["postmortems"][0])
+    try:
+        with open(summ["postmortems"][0]) as f:
+            pm = json.load(f)
+        assert pm["reason"] == "exit" and pm["failed_rank"] == 1
+        assert pm["incarnation"] == 0
+        r1 = pm["ranks"]["1"]
+        assert r1["rc"] == 5
+        assert r1["last_step"] == 6
+        assert [h["step"] for h in r1["heartbeats"]][-3:] == [4, 5, 6]
+        # the sibling pod-level scrape: rank-labeled series + pod total
+        with open(os.path.join(run_dir, "pod_metrics.prom")) as f:
+            s = parse_prometheus_text(f.read())["samples"]
+        assert s[("zoo_train_steps_total", (("rank", "0"),))] == 6
+        assert s[("zoo_train_steps_total", (("rank", "1"),))] == 6
+        assert s[("zoo_train_steps_total", ())] == 12
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+
+def test_watchdog_postmortem_names_stale_heartbeat(tmp_path):
+    """The watchdog incident's postmortem carries the hung worker's
+    heartbeat age — at least the watchdog window, since that is what
+    convicted it."""
+    import shutil
+    proc, summ = _launch(tmp_path, "hang",
+                         ["--max-restarts", "1", "--watchdog-sec", "2"])
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    assert len(summ["postmortems"]) == 1
+    run_dir = os.path.dirname(summ["postmortems"][0])
+    try:
+        with open(summ["postmortems"][0]) as f:
+            pm = json.load(f)
+        assert pm["reason"] == "watchdog" and pm["failed_rank"] == 1
+        assert pm["ranks"]["1"]["heartbeat_age_s"] >= 2.0
+        # rank 0 exited clean long before: its aging heartbeat file
+        # must NOT read as a second hung worker
+        assert pm["stale_ranks"] == [1]
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
 
 
 def test_train_metric_families_render_and_parse():
