@@ -5,7 +5,7 @@ lifecycle layer over the ``pipeline.inference`` data plane (bucketed
 executables + request coalescing + replica sets).  See docs/serving.md
 §"Control plane" and §"Elasticity"."""
 
-from . import execstore
+from . import execstore, fleet
 from .admission import AdmissionController
 from .autoscale import Autoscaler, autoscaler_for
 from .errors import (DeadlineExceeded, DeployError, ModelNotFound,
@@ -19,6 +19,6 @@ __all__ = [
     "AdmissionController", "Autoscaler", "Counters", "DeadlineExceeded",
     "DeployError", "ExecStore", "LatencyWindow", "ModelNotFound",
     "ModelRegistry", "Overloaded", "ServingError", "autoscaler_for",
-    "error_response", "execstore", "registry_collector",
+    "error_response", "execstore", "fleet", "registry_collector",
     "registry_families",
 ]

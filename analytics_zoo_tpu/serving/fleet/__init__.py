@@ -1,0 +1,28 @@
+"""Fleet serving: multi-process serving pods behind a router with a
+distributed control plane.
+
+Everything below ``serving/`` so far runs in ONE process; a fleet is
+N supervised worker processes — each the full single-process data
+plane (``ModelRegistry`` + bucketed executables + coalescer +
+admission) behind a localhost frame protocol — and a thin router that
+speaks the same serving envelope outward, spreads load
+least-outstanding-work, retries a worker death mid-request once on a
+sibling, and deploys by persisting ONE artifact + fanning out
+warm-before-swap activations that hit the shared execstore (zero
+compiles on every worker after the first).  See docs/serving.md
+§"Fleet serving".
+
+* :mod:`.protocol` — length-prefixed CRC-framed JSON envelope codec;
+* :mod:`.artifact` — the committed on-share deploy artifact;
+* :mod:`.builders` — reference artifact builders (mlp, stub);
+* :mod:`.worker` — the worker process (``python -m ...fleet.worker``);
+* :mod:`.supervisor` — per-worker crash-restart/watchdog/postmortem;
+* :mod:`.router` — scheduling, fan-out, fleet metrics.
+"""
+
+from . import artifact, builders, protocol
+from .router import FleetRouter, WorkerUnavailable
+from .supervisor import FleetSupervisor
+
+__all__ = ["FleetRouter", "FleetSupervisor", "WorkerUnavailable",
+           "artifact", "builders", "protocol"]

@@ -1,0 +1,143 @@
+"""The fleet deploy artifact: one model version persisted ONCE on the
+shared directory, activated by every worker.
+
+``deploy()`` on the router must not ship live weights over N sockets,
+and a restarted worker must be able to rebuild the version the fleet
+is serving with nobody pushing bytes at it.  So a deploy lands a
+self-describing artifact under the share::
+
+    <share>/deploys/<model>/v<version>/
+        weights.npz   # flattened param tree, raw float bytes
+        spec.json     # builder + args + registry deploy kwargs  (THE
+                      # COMMIT POINT: written last, atomic rename)
+
+``spec.json`` landing is the commit — a worker listing versions never
+sees a half-written artifact (the ``weights.npz`` of an uncommitted
+deploy is invisible until its spec renames in; same discipline as the
+checkpoint commit manifests and the execstore entries).
+
+The spec's ``builder`` is a dotted ``module:callable`` path resolved
+IN THE WORKER; called as ``builder(args, params)`` it returns the
+``ModelRegistry.deploy`` keyword dict for this version (usually
+``{"jax_fn": fn, "params": params}``, or ``{"model": handle}`` for a
+duck-typed plane — the fake worker mode used by the no-jax tier-1
+tests).  Reference builders live in :mod:`.builders`.
+
+The artifact intentionally carries NO executables: those live in the
+execstore keyed by content fingerprint — the artifact is the recipe,
+the store is the compiled result, and a worker that finds the store
+warm activates in milliseconds with zero compiles.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import re
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ...observability.flightrec import atomic_write
+
+_SPEC = "spec.json"
+_WEIGHTS = "weights.npz"
+_VDIR_RE = re.compile(r"^v(\d+)$")
+_NAME_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+def deploys_root(share_dir: str) -> str:
+    return os.path.join(share_dir, "deploys")
+
+
+def _version_dir(share_dir: str, model: str, version: int) -> str:
+    if not _NAME_RE.match(model):
+        # model names become path components; reject traversal early
+        raise ValueError(f"invalid model name {model!r}")
+    return os.path.join(deploys_root(share_dir), model, f"v{version}")
+
+
+def publish(share_dir: str, model: str, version: int,
+            params: Optional[Dict[str, Any]], spec: Dict[str, Any]
+            ) -> str:
+    """Persist one version's artifact; returns its directory.  The
+    spec lands LAST via atomic rename — its presence IS the commit.
+    ``params`` is a flat ``{name: ndarray}`` dict (None for specs
+    whose builder needs no weights)."""
+    import numpy as np
+    d = _version_dir(share_dir, model, version)
+    os.makedirs(d, exist_ok=True)
+    if params is not None:
+        tmp = os.path.join(d, f"{_WEIGHTS}.tmp.{os.getpid()}")
+        with open(tmp, "wb") as f:
+            np.savez(f, **{k: np.asarray(v) for k, v in params.items()})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(d, _WEIGHTS))
+    spec = {"model": model, "version": version,
+            "has_weights": params is not None, **spec}
+    atomic_write(os.path.join(d, _SPEC), json.dumps(spec, indent=2))
+    return d
+
+
+def load(share_dir: str, model: str, version: int
+         ) -> Tuple[Dict[str, Any], Optional[Dict[str, Any]]]:
+    """Read one committed artifact back: ``(spec, params)``."""
+    import numpy as np
+    d = _version_dir(share_dir, model, version)
+    with open(os.path.join(d, _SPEC)) as f:
+        spec = json.load(f)
+    params = None
+    if spec.get("has_weights"):
+        with np.load(os.path.join(d, _WEIGHTS)) as z:
+            params = {k: z[k] for k in z.files}
+    return spec, params
+
+
+def versions(share_dir: str, model: str) -> Dict[int, str]:
+    """Committed versions on disk: ``{version: dir}`` (only dirs whose
+    spec.json has landed — an in-flight publish is invisible)."""
+    base = os.path.join(deploys_root(share_dir), model)
+    out: Dict[int, str] = {}
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return out
+    for name in names:
+        m = _VDIR_RE.match(name)
+        d = os.path.join(base, name)
+        if m and os.path.exists(os.path.join(d, _SPEC)):
+            out[int(m.group(1))] = d
+    return out
+
+
+def resolve_builder(path: str) -> Callable:
+    """``"package.module:callable"`` to the callable itself.  The
+    worker trusts the share directory exactly as much as the execstore
+    does (operator-owned path — the spec names code to run)."""
+    if ":" not in path:
+        raise ValueError(
+            f"builder {path!r} must be 'module:callable'")
+    mod_name, attr = path.split(":", 1)
+    mod = importlib.import_module(mod_name)
+    fn = getattr(mod, attr, None)
+    if not callable(fn):
+        raise ValueError(f"builder {path!r} did not resolve to a "
+                         "callable")
+    return fn
+
+
+def build_deploy_kwargs(spec: Dict[str, Any],
+                        params: Optional[Dict[str, Any]]
+                        ) -> Dict[str, Any]:
+    """Run the spec's builder: the ``ModelRegistry.deploy`` kwargs for
+    this version (net/jax_fn+params/model plus any model_kwargs the
+    spec pins, e.g. ``max_batch_size`` — pinned so every worker pads
+    to the SAME buckets and the execstore fingerprints line up)."""
+    builder = resolve_builder(spec["builder"])
+    kwargs = dict(builder(spec.get("args") or {}, params))
+    for k, v in (spec.get("deploy_kwargs") or {}).items():
+        kwargs.setdefault(k, v)
+    if spec.get("warmup_shapes") is not None:
+        kwargs.setdefault("warmup_shapes",
+                          tuple(spec["warmup_shapes"]))
+    return kwargs

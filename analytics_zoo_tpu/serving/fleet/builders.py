@@ -1,0 +1,126 @@
+"""Reference artifact builders (see :mod:`.artifact`).
+
+A builder is ``fn(args: dict, params: dict | None) -> deploy kwargs``
+— it turns the on-disk artifact back into the thing
+``ModelRegistry.deploy`` accepts.  Two references ship here:
+
+* :func:`mlp` — a seedable tanh-MLP jax forward over the artifact's
+  weight dict (the fleet drill's workload: cheap, deterministic,
+  bucket-ladder friendly);
+* :func:`stub` — a pure-python duck-typed serving handle (numpy
+  arithmetic on the rows, no jax work) used by the fake worker mode
+  so the tier-1 supervisor/router tests exercise the whole
+  fan-out/retry machinery without a backend or a compile.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+def mlp(args: Dict[str, Any], params: Optional[Dict[str, Any]]
+        ) -> Dict[str, Any]:
+    """Layered tanh MLP whose depth comes from the weight dict itself
+    (keys ``w0..w{n-1}``) — the same shape as the loadtest rig's
+    workload, so fingerprints depend only on (weights, layer count,
+    bucket config)."""
+    import jax.numpy as jnp
+    if params is None:
+        raise ValueError("mlp builder needs artifact weights")
+    n_layers = int(args.get("n_layers", len(params)))
+
+    def forward(p, x):
+        h = x
+        for i in range(n_layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return h
+
+    return {"jax_fn": forward, "params": params}
+
+
+def lm(args: Dict[str, Any], params: Optional[Dict[str, Any]]
+       ) -> Dict[str, Any]:
+    """A deterministic TransformerLM behind the continuous-batching
+    generate path: ``ensure_inference_ready`` initializes seeded, so
+    every worker builds the SAME weights from the spec alone (no
+    artifact weights needed) and the decode-plan execstore
+    fingerprints line up fleet-wide — the web sample's /generate
+    deployment, as a fleet artifact."""
+    from ...models import TransformerLM
+    net = TransformerLM(
+        vocab_size=int(args.get("vocab_size", 32)),
+        seq_len=int(args.get("seq_len", 64)),
+        n_layers=int(args.get("n_layers", 1)),
+        d_model=int(args.get("d_model", 16)),
+        n_heads=int(args.get("n_heads", 2)))
+    net.ensure_inference_ready()
+    return {"net": net,
+            "decode_capacity": int(args.get("capacity", 2)),
+            "decode_prompt_buckets": tuple(
+                args.get("prompt_buckets", (8,))),
+            "replicas": 1}
+
+
+class StubModel:
+    """A jax-free serving handle for the fake worker mode: implements
+    the duck-typed registry surface (predict/warmup/close/
+    serving_stats).  ``scale`` makes versions distinguishable
+    bit-for-bit; ``delay_s`` shapes latency; ``die_after`` hard-kills
+    the PROCESS on the nth predict — the deterministic
+    worker-death-mid-request fixture the router retry tests use."""
+
+    def __init__(self, scale: float = 1.0, delay_s: float = 0.0,
+                 die_after: Optional[int] = None,
+                 die_rank: Optional[int] = None):
+        self.scale = float(scale)
+        self.delay_s = float(delay_s)
+        # the death hook follows the train/faults.py one-shot
+        # discipline: it only arms on a worker's FIRST incarnation
+        # (a restarted worker must not re-die forever) and, with
+        # die_rank set, only in that rank's process.  Identity comes
+        # from the flightrec helpers — one parse of the supervision
+        # env contract, shared with the recorder/log stamping.
+        from ...observability import flightrec
+        rank = flightrec._env_rank()
+        inc = flightrec._env_incarnation()
+        armed = (die_after is not None and inc == 0
+                 and (die_rank is None or rank == int(die_rank)))
+        self.die_after = die_after if armed else None
+        self._lock = threading.Lock()
+        self._served = 0
+        self._closed = False
+
+    def predict(self, inputs):
+        import numpy as np
+        with self._lock:
+            self._served += 1
+            served = self._served
+        if self.die_after is not None and served >= self.die_after:
+            # a real mid-request death: the reply never leaves
+            os._exit(17)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return np.asarray(inputs, dtype=np.float64) * self.scale
+
+    def warmup(self, shapes, dtypes=None) -> float:
+        return 0.0
+
+    def close(self):
+        self._closed = True
+
+    def serving_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"stub": True, "served": self._served,
+                    "scale": self.scale}
+
+
+def stub(args: Dict[str, Any], params: Optional[Dict[str, Any]]
+         ) -> Dict[str, Any]:
+    return {"model": StubModel(
+        scale=args.get("scale", 1.0),
+        delay_s=args.get("delay_s", 0.0),
+        die_after=args.get("die_after"),
+        die_rank=args.get("die_rank"))}

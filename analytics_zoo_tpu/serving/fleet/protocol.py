@@ -1,0 +1,198 @@
+"""Fleet wire protocol: length-prefixed JSON frames over a localhost
+socket, carrying the registry's serving envelope across processes.
+
+One frame = an 8-byte little-endian header (``payload length`` +
+``CRC32`` of the payload, the flight recorder's framing discipline
+applied to a stream) followed by the UTF-8 JSON payload.  The whole
+frame is sent with ONE ``sendall`` so a worker SIGKILLed mid-reply
+leaves the reader a cleanly detectable torn frame, never a silently
+truncated JSON document parsed as something shorter.
+
+The payload is the existing control-plane envelope verbatim:
+
+* requests — ``{"op", "id", ...op fields}`` where the op fields are
+  exactly the ``predict_ex``/``generate_ex`` keyword surface
+  (``model``, ``deadline_ms``, ``trace_id``, ``priority_class``) plus
+  the fleet control ops (``activate``, ``promote``, ``metrics``,
+  ``ping``, ``shutdown``);
+* responses — ``{"id", "ok": true, "result", "info"}`` on success, or
+  ``{"id", "ok": false, "error": <ServingError.to_dict()>}`` on
+  failure.  :func:`decode_error` reconstructs the CONCRETE serving
+  exception class on the client side — an ``Overloaded(evicted=True)``
+  raised in a worker is an ``Overloaded`` with ``evicted=True`` in the
+  router's caller, details, http_status and all.
+
+Arrays cross the wire as ``{"__nd__": {dtype, shape, b64}}`` (raw
+``tobytes`` base64) — bit-exact round-trip by construction, which the
+fleet drill's bit-identical gate leans on.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+import zlib
+from typing import Any, Dict, Optional
+
+from .. import errors as _errors
+
+_HEADER = struct.Struct("<II")  # payload length, CRC32(payload)
+
+#: hard frame bound: a fleet request is a batch of rows, not a dataset
+#: — a corrupt length prefix must not allocate gigabytes before the
+#: CRC gets a chance to convict it
+MAX_FRAME_BYTES = 256 << 20
+
+
+class FrameError(ConnectionError):
+    """A torn, short, corrupt, or oversized frame — the stream is no
+    longer trustworthy and the connection must be dropped (the router
+    treats it exactly like a worker death: retry on a sibling)."""
+
+
+def send_frame(sock: socket.socket, obj: Dict[str, Any]) -> None:
+    """Serialize + send one frame with a single ``sendall``."""
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_FRAME_BYTES:
+        raise FrameError(f"frame of {len(payload)} bytes exceeds the "
+                         f"{MAX_FRAME_BYTES} byte bound")
+    sock.sendall(_HEADER.pack(len(payload),
+                              zlib.crc32(payload) & 0xffffffff)
+                 + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes; None on clean EOF BEFORE the first
+    byte (a peer closing between frames is a normal hangup), raises
+    :class:`FrameError` on EOF mid-buffer (a torn frame)."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            if got == 0:
+                return None
+            raise FrameError(f"short read: {got}/{n} bytes then EOF")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    """Read one frame.  Returns None on a clean EOF at a frame
+    boundary; raises :class:`FrameError` on a torn frame (EOF inside
+    the header or payload), a CRC mismatch, an oversized length, or an
+    undecodable payload."""
+    head = _recv_exact(sock, _HEADER.size)
+    if head is None:
+        return None
+    length, crc = _HEADER.unpack(head)
+    if length > MAX_FRAME_BYTES:
+        raise FrameError(f"frame length {length} exceeds the "
+                         f"{MAX_FRAME_BYTES} byte bound")
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        raise FrameError(f"EOF between header and {length}-byte payload")
+    if zlib.crc32(payload) & 0xffffffff != crc:
+        raise FrameError("frame CRC mismatch")
+    try:
+        return json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise FrameError(f"undecodable frame payload: {e}") from e
+
+
+# -------------------------------------------------------------- arrays
+def encode_array(a) -> Dict[str, Any]:
+    """One ndarray as a JSON-safe dict (raw bytes, bit-exact)."""
+    import numpy as np
+    a = np.ascontiguousarray(a)
+    return {"__nd__": {"dtype": str(a.dtype), "shape": list(a.shape),
+                       "b64": base64.b64encode(a.tobytes()).decode()}}
+
+
+def decode_array(obj: Dict[str, Any]):
+    import numpy as np
+    nd = obj["__nd__"]
+    return np.frombuffer(
+        base64.b64decode(nd["b64"]),
+        dtype=np.dtype(nd["dtype"])).reshape(nd["shape"]).copy()
+
+
+def encode_value(v: Any) -> Any:
+    """Arrays (and lists/tuples/dicts containing them) to wire form;
+    everything JSON-native passes through."""
+    import numpy as np
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, (list, tuple)):
+        return [encode_value(x) for x in v]
+    if isinstance(v, dict):
+        return {k: encode_value(x) for k, x in v.items()}
+    if isinstance(v, np.ndarray) or (
+            hasattr(v, "__array__")
+            and not isinstance(v, (str, bytes, bool, int, float))):
+        return encode_array(np.asarray(v))
+    return v
+
+
+def decode_value(v: Any) -> Any:
+    if isinstance(v, dict):
+        if "__nd__" in v:
+            return decode_array(v)
+        return {k: decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [decode_value(x) for x in v]
+    return v
+
+
+# -------------------------------------------------------------- errors
+_ERROR_CLASSES = {
+    "ModelNotFound": _errors.ModelNotFound,
+    "Overloaded": _errors.Overloaded,
+    "DeadlineExceeded": _errors.DeadlineExceeded,
+    "DeployError": _errors.DeployError,
+    "ServingError": _errors.ServingError,
+}
+
+
+def _json_safe(v: Any) -> Any:
+    """Detail values must never make an error envelope unsendable: a
+    non-JSON value degrades to its repr (the caller still gets the
+    concrete class and message) instead of a TypeError that would
+    kill the connection and read as a worker death."""
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+def encode_error(exc: BaseException) -> Dict[str, Any]:
+    """An exception as the wire error envelope.  ServingErrors carry
+    their full structured ``to_dict()`` (code + message + details);
+    anything else degrades to a generic envelope with the type name —
+    same contract as :func:`..errors.error_response`."""
+    if isinstance(exc, _errors.ServingError):
+        return {k: _json_safe(v) for k, v in exc.to_dict().items()}
+    return {"error": type(exc).__name__, "message": str(exc)}
+
+
+def decode_error(payload: Dict[str, Any]) -> BaseException:
+    """The wire error envelope back into a raisable exception: known
+    serving codes reconstruct the CONCRETE class with details intact
+    (``evicted``, ``shed``, ... survive the hop); unknown codes become
+    a ``ServingError`` so the caller still gets the structured
+    surface, never a bare string."""
+    payload = dict(payload)
+    code = payload.pop("error", "ServingError")
+    message = payload.pop("message", code)
+    cls = _ERROR_CLASSES.get(code)
+    if cls is None:
+        err = _errors.ServingError(message, **payload)
+        err.details["error"] = code  # preserve the original code
+        return err
+    return cls(message, **payload)

@@ -1,0 +1,577 @@
+"""Fleet router: the thin control plane in front of the worker plane.
+
+Speaks the same admission/priority serving envelope OUTWARD that every
+worker speaks inward (``predict_ex``/``generate_ex`` with deadline,
+trace id, priority class, structured ``Overloaded``/
+``DeadlineExceeded`` errors reconstructed concretely), and owns three
+fleet-only jobs:
+
+* **Scheduling** — least-outstanding-work across live workers, ties
+  rotated (the ReplicaSet scheduler generalized across processes: one
+  outstanding-count per worker instead of one in-flight slot per
+  device).  A connection-level failure mid-request — the worker died
+  under it — is retried ONCE on a sibling, exactly like replica fault
+  tolerance retries a crashed device dispatch in-process; structured
+  serving errors are real rejections and are NEVER retried.
+* **Deploy fan-out** — ``deploy()`` persists the artifact (weights +
+  spec) on the share ONCE, then activates the version on each worker
+  ONE AT A TIME; every activation is the worker's own
+  warm-before-swap, so the rolling upgrade never takes a worker out
+  of service.  The first activation pays the compiles and populates
+  the shared execstore; every later worker (and every restarted one)
+  warms from the store in milliseconds with zero compiles — the
+  instant-fleet-deploy promise, finally gated cross-process.
+* **Observability** — ``metrics_text()`` scrapes every live worker
+  and merges the expositions through the pod aggregator (workers are
+  ranks: every sample gains a ``rank`` label, counters sum to a
+  rank-less fleet total), plus the router's own families
+  (``zoo_fleet_workers{state}``, ``zoo_fleet_router_retries_total``,
+  ``zoo_fleet_deploy_fanout_seconds``).  With a tracer installed every
+  routed request carries a span with ``route_pick`` / ``worker_call``
+  phases and a ``worker`` label.
+
+A restarted worker comes back BLANK: the supervisor's ``on_worker_up``
+hook replays the current version set onto it (warm from store) before
+the router routes any traffic at it.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...observability import aggregate as _aggregate
+from ...observability import trace as _trace
+from ...observability.log import get_logger
+from ...observability.metrics import (Family, parse_prometheus_text,
+                                      render_prometheus)
+from ..errors import ServingError
+from . import artifact, protocol
+from .supervisor import FleetSupervisor
+
+_slog = get_logger("zoo.serving.fleet.router")
+
+EXECSTORE_SUBDIR = "execstore"
+
+
+class WorkerUnavailable(ServingError):
+    """No live, routable worker could take the request (whole plane
+    restarting or dead).  503: back off and retry."""
+
+    http_status = 503
+
+
+class _Handle:
+    """Router-side view of one worker slot: endpoint + connection pool
+    + the outstanding-work count the scheduler reads."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self.port: Optional[int] = None
+        self.routable = False
+        self.outstanding = 0
+        # the pool is GENERATION-stamped: drop_conns bumps the
+        # generation, so an exchange that COMPLETED while straddling a
+        # worker death (reply buffered before the kill) cannot return
+        # its dead connection into a pool that was already cleaned
+        self.generation = 0
+        self.conns: List[Tuple[int, socket.socket]] = []
+        self.lock = threading.Lock()  # pool only
+
+    def take_conn(self, timeout: float) -> Tuple[socket.socket, int]:
+        with self.lock:
+            if self.conns:
+                return self.conns.pop()[1], self.generation
+            port, gen = self.port, self.generation
+        if port is None:
+            raise ConnectionError(f"worker {self.rank} has no endpoint")
+        s = socket.create_connection(("127.0.0.1", port),
+                                     timeout=timeout)
+        s.settimeout(timeout)
+        return s, gen
+
+    def put_conn(self, conn: socket.socket, gen: int) -> None:
+        with self.lock:
+            if gen == self.generation:
+                self.conns.append((gen, conn))
+                return
+        try:  # stale generation: the endpoint it reaches is gone
+            conn.close()
+        except OSError:
+            pass
+
+    def drop_conns(self) -> None:
+        with self.lock:
+            conns, self.conns = self.conns, []
+            self.generation += 1
+        for _, c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class FleetRouter:
+    """The fleet control plane (module docstring).
+
+    ``share_dir`` holds the deploy artifacts and (unless the caller
+    points ``ZOO_EXECSTORE_DIR`` elsewhere via ``env``) the shared
+    execstore.  ``registry_kwargs`` configure every worker's
+    ``ModelRegistry`` identically — identical bucket/admission config
+    is what makes outputs bit-identical and fingerprints shared."""
+
+    def __init__(self, share_dir: str, n_workers: int = 2, *,
+                 run_dir: Optional[str] = None,
+                 registry_kwargs: Optional[dict] = None,
+                 fake: bool = False,
+                 env: Optional[Dict[str, str]] = None,
+                 max_restarts: int = 2, restart_backoff: float = 0.5,
+                 watchdog_sec: float = 0.0,
+                 call_timeout_s: float = 120.0,
+                 tracer=None):
+        self.share_dir = os.path.abspath(share_dir)
+        os.makedirs(self.share_dir, exist_ok=True)
+        self.call_timeout_s = call_timeout_s
+        self.tracer = tracer
+        worker_env = dict(env or {})
+        if not fake:
+            worker_env.setdefault(
+                "ZOO_EXECSTORE_DIR",
+                os.path.join(self.share_dir, EXECSTORE_SUBDIR))
+        import json as _json
+        self.supervisor = FleetSupervisor(
+            n_workers,
+            run_dir or os.path.join(self.share_dir, "run"),
+            self.share_dir, fake=fake,
+            registry_json=(_json.dumps(registry_kwargs)
+                           if registry_kwargs else None),
+            env=worker_env, max_restarts=max_restarts,
+            restart_backoff=restart_backoff,
+            watchdog_sec=watchdog_sec,
+            on_worker_up=self._on_worker_up,
+            on_worker_down=self._on_worker_down)
+        self.handles = [_Handle(r) for r in range(n_workers)]
+        self._lock = threading.Lock()       # scheduling + version set
+        self._active: Dict[str, int] = {}   # model -> active version
+        self._next_version: Dict[str, int] = {}
+        self._rr = 0
+        self._retries_total = 0
+        self._req_seq = 0
+        self._fanouts: Dict[Tuple[str, int], float] = {}
+        self.last_fanout: List[Dict[str, Any]] = []
+        # rank -> the replay-activation reports of its LAST (re)start
+        # (the kill drill reads the restarted worker's compile count
+        # here: warm-from-store must be zero, cross-process)
+        self.replays: Dict[int, List[Dict[str, Any]]] = {}
+        self._reviving: set = set()  # ranks with a live revival probe
+        self._closed = False
+
+    # ---- lifecycle ----
+    def start(self, timeout: float = 120.0) -> None:
+        """Start the worker plane and wait until every worker is
+        routable (raises on timeout — a fleet that cannot field its
+        workers should fail loudly at startup, not shed mysteriously
+        later)."""
+        self.supervisor.start()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(h.routable for h in self.handles):
+                return
+            if any(w.state == "dead" for w in self.supervisor.workers):
+                break
+            time.sleep(0.05)
+        states = self.supervisor.states()
+        self.supervisor.stop()
+        raise RuntimeError(
+            f"fleet failed to start within {timeout}s: {states}")
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.supervisor.stop()
+        for h in self.handles:
+            h.drop_conns()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- supervisor hooks (monitor thread) ----
+    def _on_worker_down(self, rank: int) -> None:
+        h = self.handles[rank]
+        h.routable = False
+        h.port = None
+        h.drop_conns()
+
+    def _on_worker_up(self, rank: int, port: int,
+                      incarnation: int) -> None:
+        """A (re)started worker is blank: replay the current version
+        set onto it — warm from the shared store, so this is
+        milliseconds — BEFORE marking it routable."""
+        h = self.handles[rank]
+        h.drop_conns()
+        h.port = port
+        with self._lock:
+            replay = sorted(self._active.items())
+        reports = []
+        for model, version in replay:
+            resp = self._call(h, {"op": "activate", "model": model,
+                                  "version": version})
+            reports.append({"model": model, **resp["result"]})
+            _slog.info("fleet_replay_activate", rank=rank, model=model,
+                       version=version,
+                       compiles=resp["result"]["compiles"],
+                       warm_ms=resp["result"]["warm_ms"])
+        self.replays[rank] = reports
+        h.routable = True
+
+    # ---- wire calls ----
+    def _call(self, h: _Handle, req: Dict[str, Any]) -> Dict[str, Any]:
+        """One request/reply exchange with one worker on a pooled
+        connection.  Any transport-level failure closes the connection
+        and surfaces as ConnectionError (the worker-death signal);
+        a structured error envelope raises the reconstructed serving
+        exception."""
+        with self._lock:
+            self._req_seq += 1
+            req = {**req, "id": self._req_seq}
+        conn = None
+        try:
+            # take_conn INSIDE the normalizing try: a connect that
+            # hangs raises TimeoutError, which is an OSError but NOT
+            # a ConnectionError — without normalization a wedged
+            # accept loop would escape the retry-on-sibling contract
+            conn, gen = h.take_conn(self.call_timeout_s)
+            protocol.send_frame(conn, req)
+            resp = protocol.recv_frame(conn)
+        except (OSError, protocol.FrameError) as e:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            raise ConnectionError(
+                f"worker {h.rank} failed mid-request: "
+                f"{type(e).__name__}: {e}") from e
+        if resp is None or resp.get("id") != req["id"]:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ConnectionError(
+                f"worker {h.rank} hung up mid-request")
+        h.put_conn(conn, gen)
+        if not resp.get("ok"):
+            raise protocol.decode_error(resp.get("error") or {})
+        return resp
+
+    def _pick(self, exclude: Optional[int] = None) -> _Handle:
+        """Least-outstanding-work over routable workers, ties rotated
+        (pure min-index would camp light traffic on worker 0)."""
+        with self._lock:
+            live = [h for h in self.handles
+                    if h.routable and h.rank != exclude]
+            if not live:
+                raise WorkerUnavailable(
+                    "no live fleet worker available",
+                    states=self.supervisor.states())
+            best = min(h.outstanding for h in live)
+            candidates = [h for h in live if h.outstanding == best]
+            h = candidates[self._rr % len(candidates)]
+            self._rr += 1
+            h.outstanding += 1
+            return h
+
+    def _release(self, h: _Handle) -> None:
+        with self._lock:
+            h.outstanding -= 1
+
+    def _schedule_revival(self, h: _Handle) -> None:
+        """Router-side unrouting must be recoverable without a worker
+        restart: a DETACHED probe (PR 6's health re-probe discipline —
+        never inline on the request path) pings the worker with
+        backoff and restores it on success.  A worker that really
+        died fails every ping until the supervisor's incident path
+        takes over (``on_worker_down`` nulls the port, which ends the
+        probe; the restart's ``on_worker_up`` replay re-routes it)."""
+        with self._lock:
+            if h.rank in self._reviving:
+                return
+            self._reviving.add(h.rank)
+        threading.Thread(target=self._revive, args=(h,), daemon=True,
+                         name=f"fleet-revive-{h.rank}").start()
+
+    def _revive(self, h: _Handle) -> None:
+        try:
+            delay = 0.2
+            deadline = time.monotonic() + max(self.call_timeout_s,
+                                              30.0)
+            while time.monotonic() < deadline and not self._closed:
+                if (self.supervisor.worker(h.rank).state != "live"
+                        or h.port is None):
+                    return  # the supervisor owns this incident now
+                try:
+                    self._call(h, {"op": "ping"})
+                except (ConnectionError, ServingError):
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+                    continue
+                h.routable = True
+                _slog.info("fleet_worker_revived", rank=h.rank)
+                return
+        finally:
+            with self._lock:
+                self._reviving.discard(h.rank)
+
+    def _route_call(self, req: Dict[str, Any], span=None
+                    ) -> Dict[str, Any]:
+        """The routed data path (a zoolint hot entry): pick, call,
+        and on a worker death retry ONCE on a sibling.  The failed
+        worker is marked unroutable immediately; a detached revival
+        probe then pings it — a worker that actually died stays out
+        until the supervisor restarts + replays it, but a TRANSIENT
+        failure (one slow request tripping the call timeout on a
+        healthy worker) costs it the rotation only until the next
+        successful ping, never forever."""
+        if span is not None:
+            span.phase_start("route_pick")
+        h = self._pick()
+        if span is not None:
+            span.set_label("worker", h.rank)
+            span.phase_start("worker_call")
+        try:
+            return self._call(h, req)
+        except ConnectionError:
+            h.routable = False
+            h.drop_conns()
+            self._schedule_revival(h)
+            with self._lock:
+                self._retries_total += 1
+            _slog.warning("fleet_retry_on_sibling", failed=h.rank,
+                          op=req.get("op"))
+            if span is not None:
+                span.set_label("retried", True)
+            h2 = self._pick(exclude=h.rank)
+            if span is not None:
+                span.set_label("worker", h2.rank)
+            try:
+                return self._call(h2, req)
+            finally:
+                self._release(h2)
+        finally:
+            self._release(h)
+
+    # ---- serving surface ----
+    def predict(self, model: str, inputs,
+                deadline_ms: Optional[float] = None,
+                priority_class: Optional[str] = None):
+        out, _ = self.predict_ex(model, inputs,
+                                 deadline_ms=deadline_ms,
+                                 priority_class=priority_class)
+        return out
+
+    def predict_ex(self, model: str, inputs,
+                   deadline_ms: Optional[float] = None,
+                   trace_id: Optional[str] = None,
+                   priority_class: Optional[str] = None
+                   ) -> Tuple[Any, Dict[str, Any]]:
+        return self._serve_ex(
+            {"op": "predict", "model": model,
+             "inputs": protocol.encode_value(inputs)},
+            model, "predict", deadline_ms, trace_id, priority_class)
+
+    def generate_ex(self, model: str, prompt_ids, max_new_tokens: int,
+                    deadline_ms: Optional[float] = None,
+                    trace_id: Optional[str] = None,
+                    priority_class: Optional[str] = None,
+                    eos_id: Optional[int] = None
+                    ) -> Tuple[Any, Dict[str, Any]]:
+        return self._serve_ex(
+            {"op": "generate",
+             "prompt_ids": protocol.encode_value(prompt_ids),
+             "model": model, "max_new_tokens": int(max_new_tokens),
+             "eos_id": eos_id},
+            model, "generate", deadline_ms, trace_id, priority_class)
+
+    def _serve_ex(self, req: Dict[str, Any], model: str, op: str,
+                  deadline_ms, trace_id, priority_class
+                  ) -> Tuple[Any, Dict[str, Any]]:
+        if deadline_ms is not None:
+            req["deadline_ms"] = deadline_ms
+        if priority_class is not None:
+            req["priority_class"] = priority_class
+        tracer = self.tracer
+        span = (tracer.start_span(op, trace_id=trace_id, model=model)
+                if tracer is not None else None)
+        if span is not None:
+            req["trace_id"] = span.trace_id
+        elif trace_id is not None:
+            req["trace_id"] = trace_id
+        try:
+            with _trace.activate(span):
+                resp = self._route_call(req, span=span)
+        except BaseException as e:
+            if span is not None:
+                span.set_label("error", type(e).__name__)
+            raise
+        finally:
+            if span is not None:
+                span.finish()
+        info = dict(resp.get("info") or {})
+        if span is not None:
+            info["request_id"] = span.trace_id
+        return protocol.decode_value(resp.get("result")), info
+
+    # ---- deploy / fan-out ----
+    def deploy(self, model: str, params: Optional[Dict[str, Any]],
+               builder: str, builder_args: Optional[dict] = None,
+               warmup_shapes=None, version: Optional[int] = None,
+               deploy_kwargs: Optional[dict] = None
+               ) -> Dict[str, Any]:
+        """Fleet deploy: persist the artifact once, then activate it
+        on every worker one at a time (rolling, warm-before-swap per
+        worker).  Returns the fan-out report ``{"version",
+        "fanout_s", "activations": [{rank, compiles, warm_ms,
+        error?}, ...]}``.  A worker that dies mid-fan-out is skipped —
+        its restart replays the new version from the share."""
+        # auto-versioning is seeded from the COMMITTED artifacts on
+        # disk, not in-memory state alone: a restarted router must
+        # never reuse a version number and overwrite an artifact
+        # long-running workers still replay from (the spec rename is
+        # the commit — committed artifacts are immutable)
+        disk_floor = (max(artifact.versions(self.share_dir, model),
+                          default=0) + 1 if version is None else 0)
+        with self._lock:
+            if version is None:
+                version = max(self._next_version.get(model, 1),
+                              disk_floor)
+            self._next_version[model] = max(
+                self._next_version.get(model, 1), version + 1)
+        artifact.publish(
+            self.share_dir, model, version, params,
+            {"builder": builder, "args": builder_args or {},
+             "warmup_shapes": (list(warmup_shapes)
+                               if warmup_shapes is not None else None),
+             "deploy_kwargs": deploy_kwargs or {}})
+        # the version set updates BEFORE fan-out so a worker
+        # restarting mid-deploy replays the NEW version (activation is
+        # version-pinned and idempotent, double-activation is safe)
+        with self._lock:
+            self._active[model] = version
+        t0 = time.perf_counter()
+        activations: List[Dict[str, Any]] = []
+        for h in list(self.handles):
+            if not (h.routable or h.port is not None):
+                continue
+            entry: Dict[str, Any] = {"rank": h.rank}
+            ta = time.perf_counter()
+            try:
+                resp = self._call(h, {"op": "activate", "model": model,
+                                      "version": version})
+                entry.update(resp["result"])
+            except (ConnectionError, ServingError) as e:
+                # dead worker: its replacement replays from the share.
+                # A structured deploy failure is recorded, not raised
+                # mid-fan-out — the report carries the verdict.
+                entry["error"] = f"{type(e).__name__}: {e}"
+                _slog.error("fleet_activate_failed", rank=h.rank,
+                            model=model, version=version,
+                            error=entry["error"])
+            entry["t_start"] = round(ta - t0, 6)
+            entry["t_end"] = round(time.perf_counter() - t0, 6)
+            activations.append(entry)
+        fanout_s = round(time.perf_counter() - t0, 6)
+        with self._lock:
+            self._fanouts[(model, version)] = fanout_s
+        self.last_fanout = activations
+        _slog.info("fleet_deploy_fanout", model=model, version=version,
+                   fanout_s=fanout_s,
+                   workers=[a["rank"] for a in activations])
+        return {"version": version, "fanout_s": fanout_s,
+                "activations": activations}
+
+    def promote(self, model: str) -> Dict[str, Any]:
+        """Fan out a canary promote to every routable worker —
+        deploy's per-worker error discipline: one dead worker is
+        recorded and skipped (its replacement replays the PROMOTED
+        version set), never an aborted half-promoted fleet."""
+        results = []
+        promoted: Optional[int] = None
+        for h in list(self.handles):
+            if not h.routable:
+                continue
+            entry: Dict[str, Any] = {"rank": h.rank}
+            try:
+                resp = self._call(h, {"op": "promote", "model": model})
+                entry.update(resp["result"])
+                promoted = entry["version"]
+                # _active updates at the FIRST success (deploy's
+                # discipline): a worker restarting mid-promote must
+                # replay the promoted version, not the one it died on
+                with self._lock:
+                    self._active[model] = promoted
+            except (ConnectionError, ServingError) as e:
+                entry["error"] = f"{type(e).__name__}: {e}"
+                _slog.error("fleet_promote_failed", rank=h.rank,
+                            model=model, error=entry["error"])
+            results.append(entry)
+        return {"version": promoted, "activations": results}
+
+    def ping(self, rank: int) -> Dict[str, Any]:
+        return self._call(self.handles[rank],
+                          {"op": "ping"})["result"]
+
+    # ---- observability ----
+    def families(self) -> List[Family]:
+        states = self.supervisor.states()
+        with self._lock:
+            retries = self._retries_total
+            fanouts = dict(self._fanouts)
+        fams = [
+            Family("gauge", "zoo_fleet_workers",
+                   "fleet workers by supervision state",
+                   [({"state": s}, n) for s, n in sorted(states.items())]),
+            Family("counter", "zoo_fleet_router_retries_total",
+                   "requests retried on a sibling after a worker "
+                   "death mid-request", [({}, retries)]),
+        ]
+        if fanouts:
+            fams.append(Family(
+                "gauge", "zoo_fleet_deploy_fanout_seconds",
+                "wall seconds of the last activation fan-out per "
+                "(model, version)",
+                [({"model": m, "version": str(v)}, s)
+                 for (m, v), s in sorted(fanouts.items())]))
+        return fams
+
+    def metrics_text(self) -> str:
+        """The fleet scrape: every live worker's exposition merged
+        through the pod aggregator (rank labels + counter fleet
+        totals), the router's own families appended."""
+        pairs = []
+        for h in list(self.handles):
+            if not h.routable:
+                continue
+            try:
+                resp = self._call(h, {"op": "metrics"})
+            except (ConnectionError, ServingError):
+                continue  # a worker dying mid-scrape skips one rank
+            pairs.append((h.rank,
+                          parse_prometheus_text(resp["result"]["text"])))
+        fams = _aggregate.merge_snapshots(pairs)
+        fams.extend(self.families())
+        return render_prometheus(fams)
+
+    def states(self) -> Dict[str, int]:
+        return self.supervisor.states()
+
+    @property
+    def retries_total(self) -> int:
+        with self._lock:
+            return self._retries_total

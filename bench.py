@@ -3527,6 +3527,323 @@ def faulttrain_bench(quick: bool = False, selfcheck: bool = False,
     return 0 if (ok or not selfcheck) else 1
 
 
+# ---------------------------------------------------------------- fleet ----
+
+def _fleet_config(quick: bool) -> dict:
+    """The fleet drill's shared recipe: every worker AND the
+    single-process reference must build IDENTICAL computations (same
+    layer count, same bucket ladder) or neither the bit-exactness nor
+    the execstore-fingerprint sharing can hold."""
+    if quick:
+        return {"n_workers": 2, "n_layers": 12, "d": 32,
+                "registry": {"max_batch_size": 8, "max_queue": 256,
+                             "max_concurrency": 4},
+                "rate_hz": 40.0, "duration_s": 4.0, "event_at_s": 1.5}
+    return {"n_workers": 3, "n_layers": 24, "d": 64,
+            "registry": {"max_batch_size": 8, "max_queue": 256,
+                         "max_concurrency": 4},
+            "rate_hz": 70.0, "duration_s": 8.0, "event_at_s": 2.5}
+
+
+def _fleet_traffic(router, model, x, refs, rate_hz, duration_s,
+                   event, event_at_s):
+    """One open-loop Poisson traffic window against the fleet, with
+    ``event()`` fired from a side thread mid-window (the rolling
+    upgrade / the SIGKILL).  Every response is bit-checked against the
+    single-process reference FOR THE VERSION IT REPORTS — a response
+    from either side of a rolling swap must match that side exactly.
+    Returns (outcome counts, versions seen, event result/exc)."""
+    import threading
+
+    import numpy as np
+
+    rng = np.random.default_rng(42)
+    arrivals = _poisson_arrivals(rng, rate_hz, duration_s, 0.0,
+                                 "fleet")
+    versions_seen = set()
+    seen_lock = threading.Lock()
+
+    def issue_one(tag):
+        out, info = router.predict_ex(model, x)
+        v = info["version"]
+        with seen_lock:
+            versions_seen.add(v)
+        ref = refs.get(v)
+        if ref is None or not np.array_equal(np.asarray(out), ref):
+            raise RuntimeError(
+                f"fleet output mismatch vs single-process reference "
+                f"(version {v})")
+
+    event_result = {}
+
+    def run_event():
+        time.sleep(event_at_s)
+        try:
+            event_result["result"] = event()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            event_result["error"] = f"{type(e).__name__}: {e}"
+
+    ev = threading.Thread(target=run_event)
+    ev.start()
+    records = _run_open_loop(issue_one, arrivals, n_workers=12)
+    ev.join()
+    outcomes = {}
+    for _, _, outcome, _ in records:
+        outcomes[outcome] = outcomes.get(outcome, 0) + 1
+    return outcomes, versions_seen, event_result
+
+
+def fleet_bench(quick: bool = False, selfcheck: bool = False,
+                out_path: str = None) -> int:
+    """Fleet serving drill (``bench.py fleet``): a 2-3 worker fleet —
+    real processes under the fleet supervisor, shared execstore —
+    behind the router, under open-loop loadtest traffic, through two
+    incidents:
+
+    * **rolling upgrade** — ``router.deploy()`` of a new version
+      (different weights) mid-traffic: zero failed requests, every
+      response bit-identical to a single-process registry serving the
+      version that response reports, and the fan-out warm: only the
+      FIRST activation of each version compiles (it populates the
+      store; vacuousness check), every later worker warms with 0;
+    * **worker SIGKILL** — a worker killed mid-traffic: zero failed
+      requests (the in-flight request retries on a sibling), the
+      supervisor harvests a postmortem, and the restarted worker
+      replays the current version set from the share with 0 compiles
+      (PR 8's instant fleet deploy, gated cross-process).
+
+    Plus the fleet scrape: every worker's exposition merged rank-
+    labeled through the pod aggregator + the zoo_fleet_* families,
+    round-tripped through the stdlib parser."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from analytics_zoo_tpu.observability.metrics import \
+        parse_prometheus_text
+    from analytics_zoo_tpu.serving import ModelRegistry
+    from analytics_zoo_tpu.serving.fleet import FleetRouter
+    from analytics_zoo_tpu.serving.fleet.builders import mlp as _mlp
+
+    cfg = _fleet_config(quick)
+    results = {"quick": quick, "config": {k: v for k, v in cfg.items()
+                                          if k != "registry"}}
+    ok = True
+    work = tempfile.mkdtemp(prefix="zoo_fleet_")
+    router = None
+    local = None
+    try:
+        n_layers, d = cfg["n_layers"], cfg["d"]
+
+        def make_params(seed):
+            prng = np.random.default_rng(seed)
+            return {f"w{i}": prng.normal(size=(d, d)).astype(np.float32)
+                    * 0.1 for i in range(n_layers)}
+
+        params_v1, params_v2 = make_params(7), make_params(11)
+        x = np.random.default_rng(3).normal(size=(3, d)).astype(
+            np.float32)
+
+        worker_env = {
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+            "XLA_FLAGS": os.environ.get("XLA_FLAGS", ""),
+        }
+        # a stale training/fault contract must not leak into workers
+        for k in ("ZOO_RESUME", "ZOO_STEP_PROFILE"):
+            worker_env[k] = ""
+        router = FleetRouter(
+            os.path.join(work, "share"), n_workers=cfg["n_workers"],
+            registry_kwargs=cfg["registry"], env=worker_env,
+            max_restarts=2, restart_backoff=0.3)
+        _log(f"fleet: starting {cfg['n_workers']} workers")
+        router.start(timeout=300)
+
+        # single-process reference: SAME registry config, NO store in
+        # this process — the fleet must be bit-identical to it, and
+        # keeping the parent store-free keeps the workers' compile
+        # counts honest (nobody pre-populates the store for them)
+        builder_path = "analytics_zoo_tpu.serving.fleet.builders:mlp"
+        local = ModelRegistry(**cfg["registry"])
+        kw1 = _mlp({"n_layers": n_layers}, params_v1)
+        local.deploy("ref1", jax_fn=kw1["jax_fn"], params=kw1["params"],
+                     warmup_shapes=(d,))
+        kw2 = _mlp({"n_layers": n_layers}, params_v2)
+        local.deploy("ref2", jax_fn=kw2["jax_fn"], params=kw2["params"],
+                     warmup_shapes=(d,))
+        refs = {1: np.asarray(local.predict("ref1", x)).copy(),
+                2: np.asarray(local.predict("ref2", x)).copy()}
+
+        def fanout_gate(rep, label):
+            """First activation compiles (cold store — the vacuousness
+            check), every later one warms with exactly 0."""
+            acts = rep["activations"]
+            errs = [a for a in acts if "error" in a]
+            cold = acts[0].get("compiles", 0) if acts else 0
+            warm = [a.get("compiles") for a in acts[1:]]
+            good = (not errs and len(acts) == cfg["n_workers"]
+                    and cold > 0 and all(c == 0 for c in warm))
+            print(f"FLEET_DEPLOY_{label} version={rep['version']} "
+                  f"fanout_s={rep['fanout_s']} cold_compiles={cold} "
+                  f"warm_compiles={warm} "
+                  + ("PASS" if good else "FAIL"), flush=True)
+            return good, {"fanout_s": rep["fanout_s"],
+                          "cold_compiles": cold,
+                          "warm_compiles": warm,
+                          "errors": [a.get("error") for a in errs]}
+
+        rep1 = router.deploy("mlp", params_v1, builder_path,
+                             builder_args={"n_layers": n_layers},
+                             warmup_shapes=[d])
+        g1, results["deploy_v1"] = fanout_gate(rep1, "V1")
+        ok = ok and g1
+
+        # ---- leg A: rolling upgrade mid-traffic --------------------
+        outcomes, versions, ev = _fleet_traffic(
+            router, "mlp", x, refs, cfg["rate_hz"], cfg["duration_s"],
+            lambda: router.deploy("mlp", params_v2, builder_path,
+                                  builder_args={"n_layers": n_layers},
+                                  warmup_shapes=[d]),
+            cfg["event_at_s"])
+        failed = sum(outcomes.get(o, 0)
+                     for o in ("error", "shed", "deadline"))
+        g2 = g3 = False
+        if "error" in ev:
+            _log(f"fleet FAIL: rolling deploy raised: {ev['error']}")
+        else:
+            g2, results["deploy_v2"] = fanout_gate(ev["result"], "V2")
+            # the upgrade must have happened DURING traffic: both
+            # versions observed, nothing failed, v2 serving at the end
+            _, info = router.predict_ex("mlp", x)
+            g3 = (failed == 0 and versions == {1, 2}
+                  and info["version"] == 2)
+        results["rolling"] = {"outcomes": outcomes,
+                              "versions_seen": sorted(versions),
+                              "failed": failed,
+                              "event_error": ev.get("error")}
+        print(f"FLEET_ROLLING_UPGRADE_"
+              + ("OK" if g2 and g3 else "FAIL")
+              + f" requests={sum(outcomes.values())} failed={failed} "
+              f"versions_seen={sorted(versions)}", flush=True)
+        if not (g2 and g3):
+            ok = False
+            _log(f"fleet FAIL: rolling upgrade leg: {results['rolling']}")
+
+        # ---- leg B: SIGKILL a worker mid-traffic -------------------
+        victim = cfg["n_workers"] - 1
+        pm_before = len(router.supervisor.postmortems)
+
+        def kill_event():
+            router.supervisor.kill(victim)
+
+        outcomes_k, versions_k, ev_k = _fleet_traffic(
+            router, "mlp", x, refs, cfg["rate_hz"], cfg["duration_s"],
+            kill_event, cfg["event_at_s"])
+        failed_k = sum(outcomes_k.get(o, 0)
+                       for o in ("error", "shed", "deadline"))
+        # wait out the recovery: postmortem harvested, worker back
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if (len(router.supervisor.postmortems) > pm_before
+                    and router.states().get("live")
+                    == cfg["n_workers"]):
+                break
+            time.sleep(0.1)
+        states = router.states()
+        replay = router.replays.get(victim, [])
+        replay_compiles = sum(r.get("compiles", 0) for r in replay)
+        # vacuousness for the replay's zero: the cold fan-outs above
+        # proved an empty store DOES compile in these exact windows
+        g4 = (failed_k == 0
+              and len(router.supervisor.postmortems) > pm_before
+              and states.get("live") == cfg["n_workers"]
+              # the blank replacement replayed the CURRENT version of
+              # every model (one entry per model, v2 post-upgrade)...
+              and [(r["model"], r["version"]) for r in replay]
+              == [("mlp", 2)]
+              # ...warming purely from the shared store
+              and replay_compiles == 0)
+        results["worker_kill"] = {
+            "outcomes": outcomes_k, "failed": failed_k,
+            "victim": victim, "states_after": states,
+            "router_retries": router.retries_total,
+            "postmortems": len(router.supervisor.postmortems),
+            "replay": replay, "replay_compiles": replay_compiles,
+            "event_error": ev_k.get("error")}
+        print(f"FLEET_WORKER_KILL_" + ("OK" if g4 else "FAIL")
+              + f" requests={sum(outcomes_k.values())} "
+              f"failed={failed_k} retries={router.retries_total} "
+              f"replay_compiles={replay_compiles} "
+              f"states={states}", flush=True)
+        if not g4:
+            ok = False
+            _log(f"fleet FAIL: worker-kill leg: "
+                 f"{results['worker_kill']}")
+
+        # ---- final explicit bit-exactness + the fleet scrape -------
+        out_f = np.asarray(router.predict("mlp", x))
+        bitexact = bool(np.array_equal(out_f, refs[2]))
+        results["bitexact"] = bitexact
+        print(f"FLEET_BITEXACT vs_single_process={bitexact}",
+              flush=True)
+        if not bitexact:
+            ok = False
+
+        text = router.metrics_text()
+        try:
+            parsed = parse_prometheus_text(text)
+            names = {k[0] for k in parsed["samples"]}
+            required = {"zoo_fleet_workers",
+                        "zoo_fleet_router_retries_total",
+                        "zoo_fleet_deploy_fanout_seconds",
+                        "zoo_model_requests_total"}
+            missing = sorted(required - names)
+            ranked = [k for k in parsed["samples"]
+                      if k[0] == "zoo_model_requests_total"
+                      and "rank" in dict(k[1])]
+            fleet_total = parsed["samples"].get(
+                ("zoo_model_requests_total",
+                 (("model", "mlp"), ("version", "2"))))
+            g5 = not missing and bool(ranked) and fleet_total is not None
+            results["scrape"] = {
+                "samples": len(parsed["samples"]),
+                "missing": missing,
+                "rank_labeled_series": len(ranked),
+                "fleet_requests_total_v2": fleet_total}
+            print(f"FLEET_SCRAPE_" + ("OK" if g5 else "FAIL")
+                  + f" samples={len(parsed['samples'])} "
+                  f"rank_series={len(ranked)} missing={missing}",
+                  flush=True)
+            if not g5:
+                ok = False
+        except ValueError as e:
+            ok = False
+            _log(f"fleet FAIL: unparseable fleet scrape: {e}")
+            results["scrape"] = {"error": str(e)}
+    except (RuntimeError, OSError, KeyError, ValueError,
+            subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+        _log(f"fleet FAIL: {type(e).__name__}: {e}")
+        results["error"] = f"{type(e).__name__}: {e}"
+        ok = False
+    finally:
+        if router is not None:
+            router.close()
+        if local is not None:
+            local.shutdown()
+        shutil.rmtree(work, ignore_errors=True)
+
+    print("BENCH_FLEET " + json.dumps(results), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+    if selfcheck:
+        print("FLEET_SELFCHECK_" + ("OK" if ok else "FAIL"),
+              flush=True)
+    return 0 if (ok or not selfcheck) else 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
@@ -3592,6 +3909,22 @@ if __name__ == "__main__":
         sys.exit(faulttrain_bench(quick="--quick" in sys.argv,
                                   selfcheck="--selfcheck" in sys.argv,
                                   out_path=_out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        # workers inherit the parent's XLA_FLAGS: force 2 virtual host
+        # devices here (before jax initializes) so every process of
+        # the drill — parent reference included — agrees, unless the
+        # caller already pinned a count
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+        _out = None
+        if "--out" in sys.argv:
+            _out = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(fleet_bench(quick="--quick" in sys.argv,
+                             selfcheck="--selfcheck" in sys.argv,
+                             out_path=_out))
     elif len(sys.argv) > 1 and sys.argv[1] == "loadtest":
         # the elastic gates need >1 device: force 2 virtual host
         # devices BEFORE jax initializes (no-op when the caller — the

@@ -106,4 +106,29 @@ grep -q "COLDSTART_SELFCHECK_OK" <<<"$cs" || {
     echo "smoke FAIL: coldstart selfcheck gates failed" >&2
     exit 1
 }
+
+# Fleet-serving gate: a 2-worker fleet (real supervised processes,
+# shared execstore) behind the router, under open-loop traffic,
+# through a rolling upgrade AND a SIGKILL'd worker — zero failed
+# requests in both legs, only the FIRST activation of each version
+# compiles (every later worker and the restarted one warm from the
+# store with 0), outputs bit-identical to a single-process registry,
+# and the rank-merged fleet scrape parser-clean.
+fl=$(timeout -k 10 590 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python bench.py fleet --quick --selfcheck)
+printf '%s\n' "$fl"
+grep -Eq "FLEET_ROLLING_UPGRADE_OK .*failed=0" <<<"$fl" || {
+    echo "smoke FAIL: fleet rolling upgrade dropped requests or never ran" >&2
+    exit 1
+}
+grep -Eq "FLEET_WORKER_KILL_OK .*failed=0 .*replay_compiles=0" <<<"$fl" || {
+    echo "smoke FAIL: fleet worker-kill leg dropped requests or the" \
+         "restarted worker did not warm zero-compile from the store" >&2
+    exit 1
+}
+grep -q "FLEET_SELFCHECK_OK" <<<"$fl" || {
+    echo "smoke FAIL: fleet selfcheck gates failed" >&2
+    exit 1
+}
 echo "serving smoke OK"

@@ -1,0 +1,376 @@
+"""Fleet serving: frame-protocol codec (torn-write/short-read/CRC
+behavior, error envelope fidelity, array bit-exactness), the committed
+deploy artifact, and the supervisor/router machinery driven through
+REAL worker processes in ``--fake`` mode (no jax): deploy fan-out
+ordering, least-outstanding routing, retry-on-dead-worker,
+crash-restart with version replay, priority-class pass-through, and
+the rank-merged fleet scrape.  Fake mode does zero jax work (stub
+data plane — no backend, no compiles), so these stay fast; the
+jax-real end of all of this is ``bench.py fleet`` (smoke-gated)."""
+
+import json
+import os
+import socket
+import struct
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.serving import (DeadlineExceeded, DeployError,
+                                       ModelNotFound, Overloaded,
+                                       ServingError)
+from analytics_zoo_tpu.serving.fleet import (FleetRouter,
+                                             WorkerUnavailable,
+                                             artifact, protocol)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STUB = "analytics_zoo_tpu.serving.fleet.builders:stub"
+
+
+# ------------------------------------------------------------ protocol
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    return a, b
+
+
+def test_frame_roundtrip_and_arrays():
+    a, b = _pair()
+    try:
+        ints = np.arange(12, dtype=np.int16).reshape(3, 4)
+        x = ints.astype(np.float32)
+        x[0, 0] = np.nan  # bit-exact means NaN payload bits too
+        obj = {"op": "predict", "id": 7, "nested": [1, "s", None],
+               "inputs": protocol.encode_value(x),
+               "many": protocol.encode_value([ints, {"k": x}])}
+        protocol.send_frame(a, obj)
+        got = protocol.recv_frame(b)
+        assert got["op"] == "predict" and got["id"] == 7
+        y = protocol.decode_value(got["inputs"])
+        assert y.dtype == np.float32 and y.shape == (3, 4)
+        assert np.array_equal(y, x, equal_nan=True)
+        many = protocol.decode_value(got["many"])
+        assert many[0].dtype == np.int16
+        assert np.array_equal(many[1]["k"], x, equal_nan=True)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_clean_eof_between_frames_is_none():
+    a, b = _pair()
+    protocol.send_frame(a, {"id": 1})
+    a.close()
+    try:
+        assert protocol.recv_frame(b) == {"id": 1}
+        assert protocol.recv_frame(b) is None  # hangup, not an error
+    finally:
+        b.close()
+
+
+def test_torn_frame_raises():
+    """EOF mid-payload (a worker SIGKILLed mid-sendall's buffered
+    bytes) is a FrameError, never a short JSON parsed as truth."""
+    a, b = _pair()
+    payload = json.dumps({"id": 2, "big": "x" * 64}).encode()
+    frame = struct.pack("<II", len(payload),
+                        zlib.crc32(payload) & 0xffffffff) + payload
+    a.sendall(frame[:len(frame) - 10])  # torn: 10 bytes never arrive
+    a.close()
+    try:
+        with pytest.raises(protocol.FrameError, match="short read"):
+            protocol.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_torn_header_raises():
+    a, b = _pair()
+    a.sendall(b"\x05\x00")  # 2 of 8 header bytes
+    a.close()
+    try:
+        with pytest.raises(protocol.FrameError, match="short read"):
+            protocol.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_crc_mismatch_and_oversize_raise():
+    a, b = _pair()
+    payload = b'{"id": 3}'
+    a.sendall(struct.pack("<II", len(payload), 12345) + payload)
+    try:
+        with pytest.raises(protocol.FrameError, match="CRC"):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    a, b = _pair()
+    a.sendall(struct.pack("<II", protocol.MAX_FRAME_BYTES + 1, 0))
+    try:
+        with pytest.raises(protocol.FrameError, match="exceeds"):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.parametrize("exc,code,detail", [
+    (Overloaded("queue full", evicted=True, queue_depth=64),
+     "Overloaded", ("evicted", True)),
+    (DeadlineExceeded("hopeless", shed=True, predicted_ms=12.5),
+     "DeadlineExceeded", ("shed", True)),
+    (ModelNotFound("no such model", model="nope"),
+     "ModelNotFound", ("model", "nope")),
+    (DeployError("warmup blew up", model="m", version=3),
+     "DeployError", ("version", 3)),
+])
+def test_error_envelope_fidelity(exc, code, detail):
+    """A serving error crossing the wire reconstructs the CONCRETE
+    class with message, details, and http_status intact."""
+    back = protocol.decode_error(protocol.encode_error(exc))
+    assert type(back) is type(exc)
+    assert back.code == code
+    assert back.message == exc.message
+    k, v = detail
+    assert back.details[k] == v
+    assert back.http_status == exc.http_status
+
+
+def test_unknown_error_code_degrades_to_serving_error():
+    back = protocol.decode_error(
+        protocol.encode_error(ValueError("bad rows")))
+    assert isinstance(back, ServingError)
+    assert back.details["error"] == "ValueError"
+    assert "bad rows" in back.message
+
+
+# ------------------------------------------------------------ artifact
+def test_artifact_commit_point_is_the_spec(tmp_path):
+    share = str(tmp_path)
+    w = {"w0": np.arange(4, dtype=np.float32)}
+    d = artifact.publish(share, "m", 1, w, {"builder": STUB})
+    assert artifact.versions(share, "m") == {1: d}
+    # an in-flight publish (weights landed, spec not yet) is invisible
+    os.makedirs(os.path.join(artifact.deploys_root(share), "m", "v2"))
+    assert artifact.versions(share, "m") == {1: d}
+    spec, params = artifact.load(share, "m", 1)
+    assert spec["builder"] == STUB and spec["version"] == 1
+    assert np.array_equal(params["w0"], w["w0"])
+    with pytest.raises(ValueError, match="invalid model name"):
+        artifact.publish(share, "../evil", 1, None, {"builder": STUB})
+
+
+# ------------------------------------------------- fake-worker fleet
+@pytest.fixture
+def make_fleet(tmp_path):
+    routers = []
+
+    def make(n_workers=2, registry_kwargs=None, **kw):
+        kw.setdefault("max_restarts", 2)
+        kw.setdefault("restart_backoff", 0.2)
+        r = FleetRouter(str(tmp_path / "share"), n_workers=n_workers,
+                        fake=True, registry_kwargs=registry_kwargs,
+                        env={"PYTHONPATH": REPO}, **kw)
+        r.start(timeout=60)
+        routers.append(r)
+        return r
+
+    yield make
+    for r in routers:
+        r.close()
+
+
+def _wait(cond, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_deploy_predict_roundtrip_and_fanout_ordering(make_fleet):
+    """Deploy fans out ONE worker at a time in rank order (rolling by
+    construction: activation k+1 starts only after k completed), and
+    the served result is bit-exact for the version the info names."""
+    r = make_fleet(n_workers=2)
+    rep = r.deploy("m", None, STUB, builder_args={"scale": 2.0})
+    acts = rep["activations"]
+    assert [a["rank"] for a in acts] == [0, 1]
+    assert all("error" not in a for a in acts)
+    assert acts[0]["t_end"] <= acts[1]["t_start"]  # non-overlapping
+    x = np.arange(6, dtype=np.float64).reshape(2, 3)
+    out, info = r.predict_ex("m", x)
+    assert info["model"] == "m" and info["version"] == 1
+    assert np.array_equal(out, x * 2.0)
+    # second version: both workers swap, traffic follows
+    r.deploy("m", None, STUB, builder_args={"scale": 3.0})
+    out, info = r.predict_ex("m", x)
+    assert info["version"] == 2 and np.array_equal(out, x * 3.0)
+
+
+def test_router_retries_once_on_worker_death_mid_request(make_fleet):
+    """The deterministic mid-request death (stub die_after kills the
+    PROCESS before replying): the router must complete every request
+    on the sibling, count the retry, and the supervisor must restart
+    + replay the dead worker."""
+    r = make_fleet(n_workers=2)
+    r.deploy("m", None, STUB,
+             builder_args={"scale": 1.0, "die_after": 3,
+                           "die_rank": 1})
+    x = np.ones((1, 4))
+    for _ in range(10):
+        out, _ = r.predict_ex("m", x)
+        assert np.array_equal(out, x)  # zero failed requests
+    assert r.retries_total == 1
+    # the corpse was harvested and the replacement replayed the
+    # current version set before rejoining the rotation
+    assert _wait(lambda: r.supervisor.postmortems
+                 and r.states().get("live") == 2)
+    assert r.ping(1)["incarnation"] == 1
+    assert r.ping(1)["models"] == {"m": 1}
+    assert r.replays[1] == [
+        {"model": "m", "version": 1, "compiles": 0,
+         "store_hits": 0, "store_misses": 0,
+         "warm_ms": r.replays[1][0]["warm_ms"], "rank": 1}]
+    pm_path = r.supervisor.postmortems[0]
+    with open(pm_path) as f:
+        pm = json.load(f)
+    assert pm["failed_rank"] == 1 and pm["reason"] == "exit"
+    assert pm["ranks"]["1"]["rc"] == 17
+
+
+def test_transient_timeout_unroutes_then_revives(make_fleet):
+    """A request tripping the call timeout on a HEALTHY worker (slow
+    model, not a death) unroutes it only transiently: the detached
+    revival probe pings it back into rotation — no restart, no
+    postmortem, same incarnation."""
+    r = make_fleet(n_workers=2, call_timeout_s=0.3)
+    r.deploy("fast", None, STUB)
+    r.deploy("slow", None, STUB, builder_args={"delay_s": 0.8})
+    with pytest.raises(ConnectionError):
+        r.predict_ex("slow", np.ones((1, 2)))
+    # the picked worker was unrouted by the timeout, but it never
+    # died — the revival probe must restore it
+    assert _wait(lambda: all(h.routable for h in r.handles),
+                 timeout=10)
+    out, _ = r.predict_ex("fast", np.ones((1, 2)))
+    assert np.array_equal(out, np.ones((1, 2)))
+    assert r.supervisor.postmortems == []
+    assert [r.ping(rk)["incarnation"] for rk in (0, 1)] == [0, 0]
+
+
+def test_all_workers_dead_raises_worker_unavailable(make_fleet):
+    r = make_fleet(n_workers=1, max_restarts=0)
+    r.deploy("m", None, STUB)
+    r.supervisor.kill(0)
+    assert _wait(lambda: r.states().get("dead") == 1)
+    with pytest.raises(WorkerUnavailable) as ei:
+        r.predict_ex("m", np.ones((1, 2)))
+    assert ei.value.http_status == 503
+    assert ei.value.details["states"]["dead"] == 1
+
+
+def test_priority_class_and_structured_errors_cross_process(make_fleet):
+    """The admission envelope survives the hop: a priority class tags
+    the worker-side controller's counters, and a predictive deadline
+    shed comes back as DeadlineExceeded(shed=True) — details intact."""
+    r = make_fleet(
+        n_workers=1,
+        registry_kwargs={"priority_classes": {"gold": [10, 0.9]},
+                         "max_queue": 8, "max_concurrency": 1})
+    r.deploy("m", None, STUB, builder_args={"delay_s": 0.05})
+    x = np.ones((1, 2))
+    out, _ = r.predict_ex("m", x, priority_class="gold")
+    assert np.array_equal(out, x)
+    # the 50ms EWMA is seeded: a 1ms deadline is predictively hopeless
+    with pytest.raises(DeadlineExceeded) as ei:
+        r.predict_ex("m", x, deadline_ms=1.0, priority_class="gold")
+    assert ei.value.details.get("shed") is True
+    # the class rode admission on the WORKER: its counters prove it
+    from analytics_zoo_tpu.observability.metrics import \
+        parse_prometheus_text
+    s = parse_prometheus_text(r.metrics_text())["samples"]
+    assert s[("zoo_class_admitted_total",
+              (("class", "gold"), ("model", "m"),
+               ("rank", "0")))] == 1.0
+    assert s[("zoo_shed_total",
+              (("class", "gold"), ("model", "m"),
+               ("rank", "0")))] == 1.0
+
+
+def test_fleet_scrape_merges_ranks_and_fleet_families(make_fleet):
+    """Router /metrics = every worker's exposition rank-labeled and
+    merged (counters gain a rank-less fleet total) + the router's own
+    zoo_fleet_* families."""
+    from analytics_zoo_tpu.observability.metrics import \
+        parse_prometheus_text
+    r = make_fleet(n_workers=2)
+    r.deploy("m", None, STUB)
+    x = np.ones((1, 2))
+    for _ in range(4):
+        r.predict("m", x)
+    parsed = parse_prometheus_text(r.metrics_text())
+    s = parsed["samples"]
+    assert parsed["types"]["zoo_fleet_workers"] == "gauge"
+    assert s[("zoo_fleet_workers", (("state", "live"),))] == 2
+    assert s[("zoo_fleet_workers", (("state", "dead"),))] == 0
+    assert parsed["types"]["zoo_fleet_router_retries_total"] \
+        == "counter"
+    assert s[("zoo_fleet_router_retries_total", ())] == 0
+    assert s[("zoo_fleet_deploy_fanout_seconds",
+              (("model", "m"), ("version", "1")))] >= 0
+    # per-rank requests + the rank-less fleet total summing them
+    per_rank = [s.get(("zoo_model_requests_total",
+                       (("model", "m"), ("rank", str(rk)),
+                        ("version", "1")))) for rk in (0, 1)]
+    total = s[("zoo_model_requests_total",
+               (("model", "m"), ("version", "1")))]
+    assert sum(v for v in per_rank if v is not None) == total == 4.0
+
+
+def test_restarted_router_never_reuses_versions(tmp_path):
+    """Auto-versioning is seeded from the COMMITTED artifacts on
+    disk: a second router lifetime over the same share continues the
+    version sequence instead of overwriting v1 (committed artifacts
+    are immutable — long-running workers replay from them)."""
+    share = str(tmp_path / "share")
+    env = {"PYTHONPATH": REPO}
+    r1 = FleetRouter(share, n_workers=1, fake=True, env=env)
+    try:
+        r1.start(timeout=60)
+        assert r1.deploy("m", None, STUB)["version"] == 1
+    finally:
+        r1.close()
+    r2 = FleetRouter(share, n_workers=1, fake=True, env=env)
+    try:
+        r2.start(timeout=60)
+        assert r2.deploy("m", None, STUB)["version"] == 2
+        assert sorted(artifact.versions(share, "m")) == [1, 2]
+        out, info = r2.predict_ex("m", np.ones((1, 2)))
+        assert info["version"] == 2
+    finally:
+        r2.close()
+
+
+def test_least_outstanding_spreads_and_ping(make_fleet):
+    """Sequential requests against idle workers rotate (ties rotate
+    round-robin), so both workers serve; ping reports identity."""
+    r = make_fleet(n_workers=2)
+    r.deploy("m", None, STUB)
+    x = np.ones((2, 2))
+    for _ in range(8):
+        r.predict("m", x)
+    served = [r.ping(rk)["models"] for rk in (0, 1)]
+    assert served == [{"m": 1}, {"m": 1}]
+    from analytics_zoo_tpu.observability.metrics import \
+        parse_prometheus_text
+    s = parse_prometheus_text(r.metrics_text())["samples"]
+    counts = [s.get(("zoo_model_requests_total",
+                     (("model", "m"), ("rank", str(rk)),
+                      ("version", "1")))) for rk in (0, 1)]
+    assert all(c and c >= 3 for c in counts), counts
