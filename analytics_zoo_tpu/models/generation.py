@@ -162,21 +162,175 @@ def _decode_step(params, hyper, caches, x_tok, pos):
     return _head_logits(params, x), new_caches
 
 
-def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+def _decode_window(params, hyper, caches, x_toks, pos):
+    """k-query cached decode — the speculative-verify compute.
+
+    ``x_toks`` is (b, k, d_model): the embeddings of k consecutive
+    tokens per row, whose positions are ``pos + j`` (``pos``: (b,)).
+    Each token's K/V is written at its OWN clamped position (per-entry
+    ``min(pos + j, t - 1)``, never a block write — a block's clamp
+    would SHIFT early entries and corrupt live cache lines), then all
+    k queries attend in one batched einsum with a per-query causal
+    mask.  Returns ((b, k, V) logits, updated caches).
+
+    Numerics note: the k-query matmul shapes differ from
+    :func:`_decode_step`'s single-query shapes, so logits agree with k
+    sequential steps to ~1 ulp, not bit-for-bit — which is why the
+    speculative plan derives each window's FIRST token from the exact
+    single-query body and uses this window only to certify draft
+    proposals (decode.py §speculative)."""
+    n_layers, moe_every = hyper["n_layers"], hyper["moe_every"]
+    k = x_toks.shape[1]
+    t = caches[0][0].shape[2]
+    x = x_toks
+    qpos = jnp.minimum(pos[:, None] + jnp.arange(k)[None, :], t - 1)
+    new_caches = []
+    for i in range(n_layers):
+        moe = bool(moe_every) and (i + 1) % moe_every == 0
+        bp = _block_params(params, i, moe)
+        ck, cv = caches[i]
+        a = _layer_norm(bp["ln_a"], x)
+        q = jnp.einsum("bke,ehd->bhkd", a, bp["attn"]["Wq"])
+        kk = jnp.einsum("bke,ehd->bhkd", a, bp["attn"]["Wk"])
+        vv = jnp.einsum("bke,ehd->bhkd", a, bp["attn"]["Wv"])
+        for j in range(k):
+            ck = _cache_write(ck, kk[:, :, j], qpos[:, j])
+            cv = _cache_write(cv, vv[:, :, j], qpos[:, j])
+        d = q.shape[-1]
+        scores = jnp.einsum("bhkd,bhtd->bhkt", q, ck) / math.sqrt(d)
+        valid = (jnp.arange(t)[None, None, None, :]
+                 <= qpos[:, None, :, None])
+        scores = jnp.where(valid, scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        o = jnp.einsum("bhkt,bhtd->bhkd", probs.astype(cv.dtype), cv)
+        x = x + jnp.einsum("bhkd,hde->bke", o, bp["attn"]["Wo"])
+        f = _layer_norm(bp["ln_m"], x)
+        x = x + _mlp(bp, f)
+        new_caches.append((ck, cv))
+    b = x.shape[0]
+    logits = _head_logits(params, x.reshape(b * k, -1))
+    return logits.reshape(b, k, -1), new_caches
+
+
+def _prefill_ext(params, hyper, tail, prefix_kv, p_len: int):
+    """Prefix-conditioned tail prefill — the prefix-KV-pool admit
+    compute.  ``tail`` is (1, s_t) token ids occupying positions
+    ``[p_len, p_len + s_t)``; ``prefix_kv`` the per-layer (k, v)
+    blocks of the first ``p_len`` positions, each (1, heads, p_len,
+    d_head) — pooled (a memcpy) or freshly computed by the same
+    prefix-prefill plan (bit-identical either way, which is what makes
+    pool hit vs miss streams indistinguishable).  Causal attention of
+    the tail queries over prefix + tail in one batched forward.
+    Returns (tail hidden states (1, s_t, d_model), per-layer tail
+    (k, v) blocks (1, heads, s_t, d_head))."""
+    n_layers, moe_every = hyper["n_layers"], hyper["moe_every"]
+    s_t = tail.shape[1]
+    x = jnp.take(params["tok_embed"]["embeddings"],
+                 tail.astype(jnp.int32), axis=0)
+    x = x + params["pos_embed"]["table"][p_len:p_len + s_t].astype(
+        x.dtype)
+    tail_caches = []
+    # tail query j (position p_len + j) sees the whole prefix plus
+    # tail positions <= j
+    causal = (jnp.arange(s_t)[None, None, :, None]
+              >= jnp.arange(s_t)[None, None, None, :])
+    for i in range(n_layers):
+        moe = bool(moe_every) and (i + 1) % moe_every == 0
+        bp = _block_params(params, i, moe)
+        pk, pv = prefix_kv[i]
+        a = _layer_norm(bp["ln_a"], x)
+        q = jnp.einsum("bse,ehd->bhsd", a, bp["attn"]["Wq"])
+        k = jnp.einsum("bse,ehd->bhsd", a, bp["attn"]["Wk"])
+        v = jnp.einsum("bse,ehd->bhsd", a, bp["attn"]["Wv"])
+        d = q.shape[-1]
+        sp = jnp.einsum("bhsd,bhtd->bhst", q, pk) / math.sqrt(d)
+        st = jnp.einsum("bhsd,bhtd->bhst", q, k) / math.sqrt(d)
+        st = jnp.where(causal, st, -1e30)
+        scores = jnp.concatenate([sp, st], axis=-1)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        vall = jnp.concatenate([pv, v], axis=2)
+        o = jnp.einsum("bhst,bhtd->bhsd", probs.astype(vall.dtype),
+                       vall)
+        x = x + jnp.einsum("bhsd,hde->bse", o, bp["attn"]["Wo"])
+        f = _layer_norm(bp["ln_m"], x)
+        x = x + _mlp(bp, f)
+        tail_caches.append((k, v))
+    return x, tail_caches
+
+
+def _sample(logits, rng, temperature, top_k: Optional[int] = None,
+            top_p: Optional[float] = None):
     """Greedy when temperature == 0, else temperature softmax with
-    optional top-k truncation.  Static branch: temperature/top_k are
-    Python values baked into the compiled plan."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
-    scaled = logits.astype(jnp.float32) / temperature
-    if top_k is not None:
-        kth = lax.top_k(scaled, top_k)[0][..., -1:]
-        scaled = jnp.where(scaled >= kth, scaled, -1e30)
-    return jax.random.categorical(rng, scaled, axis=-1)
+    optional top-k and/or top-p (nucleus) truncation.
+
+    The ONE sampling implementation both decode paths share: the
+    compiled-scan path (``build_generate_fn``) passes Python values
+    (static branch — greedy compiles to a bare argmax, exactly the
+    pre-sampling plan), while the slot-array ``DecodeEngine`` passes
+    traced per-slot scalars (``top_k <= 0`` / ``top_p >= 1`` disable),
+    in which case greedy-vs-sampled is an in-graph select — a
+    ``temperature == 0`` slot still yields the bit-exact argmax token,
+    which is what keeps the engine's greedy streams identical to this
+    function's static-greedy plan.
+
+    The sampled path is ONE descending ``top_k(V)`` (values + source
+    indices), both truncation thresholds off the same sorted array,
+    and an inverse-CDF draw from ONE uniform per row — deliberately
+    not V gumbels + two sorts: this runs per decode step (and per
+    speculative window position), where the cheap transform keeps
+    sampled decode within the bench's overhead bound of greedy."""
+    greedy = jnp.argmax(logits, axis=-1)
+    static_t = isinstance(temperature, (int, float))
+    if static_t and float(temperature) == 0.0:
+        return greedy
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    scaled = logits.astype(jnp.float32) / t
+    V = scaled.shape[-1]
+    srt, src = lax.top_k(scaled, V)  # descending values + indices
+    # top-k threshold: the k-th sorted value (disabled -> -inf)
+    if top_k is None:
+        kth = -jnp.inf
+    elif isinstance(top_k, int):
+        kth = srt[..., top_k - 1:top_k]
+    else:
+        idx = jnp.clip(top_k - 1, 0, V - 1).astype(jnp.int32)
+        kth = lax.dynamic_index_in_dim(srt, idx, axis=-1,
+                                       keepdims=True)
+        kth = jnp.where(top_k > 0, kth, -jnp.inf)
+    # unnormalized sorted probabilities (shared by top-p + the draw)
+    e = jnp.exp(srt - srt[..., :1])
+    csum = jnp.cumsum(e, axis=-1)
+    # nucleus threshold: keep the sorted prefix whose mass STRICTLY
+    # BEFORE each entry is < p of the total — the top token's before-
+    # mass is 0, so at least one entry always survives
+    if top_p is None:
+        pth = -jnp.inf
+    else:
+        keep = (csum - e) < jnp.asarray(top_p,
+                                        jnp.float32) * csum[..., -1:]
+        pth = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                      keepdims=True)
+    thr = jnp.maximum(kth, pth)
+    ek = jnp.where(srt >= thr, e, 0.0)
+    ck = jnp.cumsum(ek, axis=-1)
+    u = jax.random.uniform(rng, logits.shape[:-1],
+                           jnp.float32)[..., None] * ck[..., -1:]
+    pick = jnp.sum((ck <= u).astype(jnp.int32), axis=-1)
+    # u can round up to exactly ck[-1] (uniform near 1 x the total),
+    # making every cumsum entry <= u — clamp to the KEPT prefix so a
+    # truncation-excluded token can never be drawn
+    kept = jnp.sum((ek > 0.0).astype(jnp.int32), axis=-1)
+    pick = jnp.clip(pick, 0, jnp.maximum(kept - 1, 0))
+    sampled = jnp.take_along_axis(src, pick[..., None],
+                                  axis=-1)[..., 0]
+    if static_t:
+        return sampled
+    return jnp.where(jnp.asarray(temperature) > 0.0, sampled, greedy)
 
 
 def build_generate_fn(hyper, s_p: int, max_new: int, temperature: float,
-                      top_k: Optional[int], ragged: bool = False):
+                      top_k: Optional[int], top_p: Optional[float] = None,
+                      ragged: bool = False):
     """Compile one generation plan: (params, prompt, rng) -> (b, max_new)
     sampled token ids — or, with ``ragged``, (params, prompt, lengths,
     rng) where right-padded rows decode from their own (b,) prompt
@@ -194,7 +348,7 @@ def build_generate_fn(hyper, s_p: int, max_new: int, temperature: float,
             last_hidden = x[jnp.arange(x.shape[0]), lengths - 1]
         logits0 = _head_logits(params, last_hidden)
         rng0, rng_loop = jax.random.split(rng)
-        tok0 = _sample(logits0, rng0, temperature, top_k)
+        tok0 = _sample(logits0, rng0, temperature, top_k, top_p)
 
         def step(carry, i):
             tok, caches, r = carry
@@ -202,7 +356,7 @@ def build_generate_fn(hyper, s_p: int, max_new: int, temperature: float,
             pos = (s_p + i) if lengths is None else (lengths + i)
             emb = _embed_token(params, tok, pos)
             logits, caches = _decode_step(params, hyper, caches, emb, pos)
-            nxt = _sample(logits, r_step, temperature, top_k)
+            nxt = _sample(logits, r_step, temperature, top_k, top_p)
             return (nxt, caches, r), tok
 
         (_, _, _), toks = lax.scan(
@@ -302,6 +456,7 @@ def _plan_cache(model, key, build):
 
 def generate(model, prompt_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              seed: int = 0, num_beams: int = 1,
              prompt_lengths=None) -> np.ndarray:
     """Generate continuations for a batch of equal-length prompts.
@@ -315,6 +470,10 @@ def generate(model, prompt_ids, max_new_tokens: int,
             temperature-scaled distribution.
         top_k: optional truncation to the k most likely tokens before
             sampling (ignored when greedy).
+        top_p: optional nucleus truncation — sample from the smallest
+            descending-probability set reaching mass ``top_p``
+            (ignored when greedy; composable with top_k, which is
+            applied first).
         num_beams: > 1 runs deterministic beam search over that many
             beams (temperature/top_k must be unset) and returns each
             batch row's highest-log-prob sequence.
@@ -365,10 +524,10 @@ def generate(model, prompt_ids, max_new_tokens: int,
         # paths without building a plan (beam keeps its >= 1 raise)
         return prompt.astype(np.int32)
     if num_beams > 1:
-        if temperature != 0.0 or top_k is not None:
+        if temperature != 0.0 or top_k is not None or top_p is not None:
             raise ValueError(
                 "beam search (num_beams > 1) is deterministic — "
-                "temperature/top_k do not apply")
+                "temperature/top_k/top_p do not apply")
         if max_new_tokens < 1:
             # the beam plan always scores at least the first token, so
             # a 0-token request cannot keep the output-shape contract
@@ -389,11 +548,13 @@ def generate(model, prompt_ids, max_new_tokens: int,
                               axis=1)
     ragged = prompt_lengths is not None
     key = (s_p, int(max_new_tokens), float(temperature),
-           None if top_k is None else int(top_k), ragged)
+           None if top_k is None else int(top_k),
+           None if top_p is None else float(top_p), ragged)
     fn = _plan_cache(model, key,
                      lambda: build_generate_fn(
                          h, s_p, int(max_new_tokens), float(temperature),
                          None if top_k is None else int(top_k),
+                         None if top_p is None else float(top_p),
                          ragged=ragged))
     if ragged:
         toks = fn(trainer.state.params, jnp.asarray(prompt),

@@ -122,16 +122,17 @@ class TransformerLM(ZooModel):
         return Model(input=tokens, output=out, name="transformer_lm")
 
     def generate(self, prompt_ids, max_new_tokens: int,
-                 temperature: float = 0.0, top_k=None, seed: int = 0,
-                 num_beams: int = 1, prompt_lengths=None):
+                 temperature: float = 0.0, top_k=None, top_p=None,
+                 seed: int = 0, num_beams: int = 1,
+                 prompt_lengths=None):
         """Autoregressive continuation from a KV cache — greedy
-        (``temperature=0``), temperature/top-k sampling, or beam search
-        (``num_beams > 1``); ragged right-padded prompts decode from
-        their own ``prompt_lengths``.  The whole decode runs as ONE
-        compiled scan.  See
+        (``temperature=0``), temperature/top-k/top-p sampling, or beam
+        search (``num_beams > 1``); ragged right-padded prompts decode
+        from their own ``prompt_lengths``.  The whole decode runs as
+        ONE compiled scan.  See
         :func:`analytics_zoo_tpu.models.generation.generate`."""
         from .generation import generate
         return generate(self, prompt_ids, max_new_tokens,
-                        temperature=temperature, top_k=top_k, seed=seed,
-                        num_beams=num_beams,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, seed=seed, num_beams=num_beams,
                         prompt_lengths=prompt_lengths)

@@ -33,20 +33,62 @@ Decode math: :mod:`analytics_zoo_tpu.models.generation`'s
 ``_prefill`` / ``_decode_step`` — the same per-row-position (ragged)
 formulation ``TransformerLM.generate`` compiles into its scan, so a
 slot stepped one token at a time is pinned token-identical to the
-scan path (tests/test_serving_decode.py).  Greedy only: iteration-level
-scheduling interleaves unrelated requests in one dispatch, and greedy
-argmax is the one sampling mode whose per-slot stream provably cannot
-depend on its neighbors.
+scan path (tests/test_serving_decode.py).
+
+Decode engine v2 (ISSUE 14) extends the slot array with three
+independently-gated stages, all preserving the
+one-compile-per-(bucket, capacity, plan), sanitize-clean, and
+bit-exact-replay invariants:
+
+* **Per-slot sampling.**  temperature/top-k/top-p ride the slot array
+  as DYNAMIC per-slot values (static configs would recompile the step
+  per sampling mix), and each slot draws from its own
+  ``fold_in(PRNGKey(request seed), absolute token index)`` key — the
+  trainer's absolute-step fold_in discipline applied per stream.
+  Because a slot's logits depend only on its own cache (masked
+  attention) and its key only on (seed, index), streams are
+  independent, bit-replayable, and occupancy-invariant; a
+  ``temperature == 0`` slot selects the bare argmax, bit-identical to
+  the pre-sampling greedy engine.
+* **Prefix-KV pool.**  Prompts are split at the largest prompt-bucket
+  boundary <= their length; the prefix block's per-layer K/V (and its
+  last hidden state) is content-hash cached in a small on-device LRU
+  pool, so a shared-system-prompt admission is a
+  ``dynamic_update_slice`` memcpy plus a short tail prefill instead
+  of a full-prompt recompute.  A pool hit copies bits a previous
+  prefix-prefill produced and a miss recomputes them with the same
+  plan, so hit and miss streams are bit-identical by construction;
+  eviction (LRU beyond the pool bound) just recomputes — never a
+  wrong prefix (the key is the prefix CONTENT hash).
+* **Speculative decoding.**  A small draft model proposes
+  ``spec_tokens - 1`` tokens per slot (a scan inside ONE dispatch);
+  the target then takes one EXACT single-query step (the same traced
+  body as the non-speculative plan — the bit-exact fallback token)
+  and verifies the proposals with a k-query windowed forward
+  (training-shaped matmuls).  Accepted proposals emit up to
+  ``spec_tokens`` tokens per dispatch; a rejection falls back to the
+  exact step's token, bit-identical to the non-speculative stream BY
+  CONSTRUCTION (full rejection degrades to exactly the plain
+  engine's computation).  Accepted window tokens are selected from
+  the verify pass's own logits, which match the single-query step to
+  ~1 ulp — identical selections on this backend (tests and the bench
+  pin spec ≡ plain empirically); a near-tie flip under a backend
+  whose window kernels round differently is the only theoretical
+  divergence channel.  Sampled verification draws each window
+  position from the same per-slot fold_in key the non-speculative
+  path would use.
 
 Data movement is explicit (``device_put`` in, ``device_get`` out) so
 the whole loop runs clean under ``zoolint.sanitize()`` transfer
 guards; the decode state itself never leaves the device — the per-step
-host traffic is one (capacity,) token fetch.
+host traffic is one (capacity,) token fetch (plus the (spec_tokens,
+capacity) token matrix and acceptance vector per speculative window).
 """
 
 from __future__ import annotations
 
 import collections
+import hashlib
 import queue
 import threading
 import time
@@ -57,8 +99,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...models.generation import (_decode_step, _embed_token,
-                                  _head_logits, _prefill)
+from ...models.generation import (_decode_step, _decode_window,
+                                  _embed_token, _head_logits, _prefill,
+                                  _prefill_ext, _sample)
 from ...observability import profile as _profile
 from ...observability.log import get_logger as _get_logger
 from .serving import _execstore, bucket_ladder
@@ -153,11 +196,14 @@ class _DecodeRequest:
     # yet processed) steps — the pipelined loop plans fused windows
     # from it, since ``produced`` lags by the in-flight dispatch.
     __slots__ = ("prompt", "length", "bucket", "max_new", "eos_id",
-                 "stream", "span", "produced", "scheduled", "slot")
+                 "stream", "span", "produced", "scheduled", "slot",
+                 "temperature", "top_k", "top_p", "seed")
 
     def __init__(self, prompt: np.ndarray, length: int, bucket: int,
                  max_new: int, eos_id: Optional[int], stream: TokenStream,
-                 span=None):
+                 span=None, temperature: float = 0.0,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, seed: int = 0):
         self.prompt = prompt
         self.length = length
         self.bucket = bucket
@@ -168,6 +214,65 @@ class _DecodeRequest:
         self.produced = 0
         self.scheduled = 0
         self.slot = -1
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = seed
+
+
+class _PrefixEntry:
+    """One pooled prefix: the per-layer (k, v) device blocks of a
+    prefix-prefill plus its last position's hidden state (the logits
+    source for a prompt that IS exactly the prefix)."""
+
+    __slots__ = ("kv", "h_last", "p_len")
+
+    def __init__(self, kv, h_last, p_len: int):
+        self.kv = kv
+        self.h_last = h_last
+        self.p_len = p_len
+
+
+class _PrefixPool:
+    """Dispatcher-owned LRU of prefix-KV blocks, keyed on the prefix
+    CONTENT hash (sha256 over (prefix length, token bytes)) — a
+    collision-free key means an entry can only ever serve the exact
+    prefix it was computed from.  Eviction (beyond ``size`` entries)
+    drops the device arrays; a later admission of that prefix simply
+    recomputes (counted, never wrong).  Single-threaded by protocol
+    (only the dispatcher touches it), like the slot bookkeeping."""
+
+    def __init__(self, size: int):
+        self.size = int(size)
+        self.entries: "collections.OrderedDict[str, _PrefixEntry]" = \
+            collections.OrderedDict()
+
+    @staticmethod
+    def key(prefix_ids: np.ndarray) -> str:
+        ids = np.ascontiguousarray(prefix_ids, np.int32)
+        h = hashlib.sha256()
+        h.update(repr(ids.shape).encode())
+        h.update(ids.tobytes())
+        return h.hexdigest()
+
+    def get(self, key: str) -> Optional[_PrefixEntry]:
+        ent = self.entries.get(key)
+        if ent is not None:
+            self.entries.move_to_end(key)
+        return ent
+
+    def put(self, key: str, entry: _PrefixEntry) -> int:
+        """Insert (most-recent) and trim to ``size``; returns how many
+        entries the bound evicted (their device arrays are freed with
+        the last reference — memory pressure resolves to a later
+        recompute, never a wrong block)."""
+        self.entries[key] = entry
+        self.entries.move_to_end(key)
+        evicted = 0
+        while len(self.entries) > self.size:
+            self.entries.popitem(last=False)
+            evicted += 1
+        return evicted
 
 
 _SHUTDOWN = object()
@@ -198,6 +303,18 @@ class DecodeEngine:
             dispatch as ONE compiled scan, amortizing per-dispatch
             overhead without giving up iteration-level scheduling
             (1 disables fusion; see ``_choose_fuse``).
+        prefix_pool: > 0 keeps that many prefix-KV blocks in an
+            on-device LRU pool — admissions whose prompt shares a
+            bucket-aligned prefix with a pooled block skip the
+            prefix's prefill compute (module docstring §Prefix-KV
+            pool).  0 (default) disables: admission is the monolithic
+            single-plan prefill, bit-identical to the v1 engine.
+        draft_params / draft_hyper: a small draft model (same vocab)
+            enables speculative decoding — up to ``spec_tokens``
+            tokens per dispatch (module docstring §Speculative).
+            Mutually exclusive with ``prefix_pool`` for now.
+        spec_tokens: tokens per speculative window (1 exact + up to
+            ``spec_tokens - 1`` certified draft proposals).
         device: jax device for the decode state (default: the first
             local device).
     """
@@ -206,9 +323,34 @@ class DecodeEngine:
                  max_len: Optional[int] = None,
                  prompt_buckets: Optional[Sequence[int]] = None,
                  eos_id: Optional[int] = None, max_queue: int = 256,
-                 step_fuse: int = 4, device=None):
+                 step_fuse: int = 4, prefix_pool: int = 0,
+                 draft_params=None, draft_hyper: Optional[Dict] = None,
+                 spec_tokens: int = 4, device=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if (draft_params is None) != (draft_hyper is None):
+            raise ValueError(
+                "speculative decoding needs BOTH draft_params and "
+                "draft_hyper (or neither)")
+        if int(prefix_pool) < 0:
+            raise ValueError(
+                f"prefix_pool must be >= 0, got {prefix_pool}")
+        if draft_params is not None and prefix_pool:
+            raise ValueError(
+                "draft (speculative) and prefix_pool are mutually "
+                "exclusive in this engine version — the pooled prefix "
+                "blocks would need a draft-cache twin")
+        if draft_params is not None and spec_tokens < 2:
+            raise ValueError(
+                f"spec_tokens must be >= 2 (1 exact + >=1 proposed), "
+                f"got {spec_tokens}")
+        if draft_hyper is not None \
+                and int(draft_hyper["vocab_size"]) != int(
+                    hyper["vocab_size"]):
+            raise ValueError(
+                "draft and target must share a vocabulary "
+                f"({draft_hyper['vocab_size']} vs "
+                f"{hyper['vocab_size']})")
         self.capacity = int(capacity)
         self.step_fuse = max(1, int(step_fuse))
         self._hyper = dict(hyper)
@@ -232,6 +374,19 @@ class DecodeEngine:
         self._device = device or jax.local_devices()[0]
         self._params = jax.device_put(params, self._device)
         self._n_layers = int(hyper["n_layers"])
+        self.spec_tokens = int(spec_tokens)
+        self._draft_hyper = (None if draft_hyper is None
+                             else dict(draft_hyper))
+        if self._draft_hyper is not None:
+            if int(self._draft_hyper["max_len"]) < self.max_len:
+                raise ValueError(
+                    f"draft positional table "
+                    f"({self._draft_hyper['max_len']}) is shorter than "
+                    f"the engine's max_len ({self.max_len})")
+            self._draft_params = jax.device_put(draft_params,
+                                                self._device)
+        else:
+            self._draft_params = None
 
         # ---- device state: the persistent slot array.  jnp.zeros
         # builds ON the device (a fill, not a transfer); tok/pos for
@@ -245,8 +400,27 @@ class DecodeEngine:
             caches = [(jnp.zeros(shape, jnp.float32),
                        jnp.zeros(shape, jnp.float32))
                       for _ in range(self._n_layers)]
+            dcaches = []
+            if self._draft_hyper is not None:
+                dh = self._draft_hyper
+                dshape = (self.capacity, int(dh["n_heads"]),
+                          self.max_len,
+                          int(dh["d_model"]) // int(dh["n_heads"]))
+                dcaches = [(jnp.zeros(dshape, jnp.float32),
+                            jnp.zeros(dshape, jnp.float32))
+                           for _ in range(int(dh["n_layers"]))]
             tok = jnp.zeros((self.capacity,), jnp.int32)
             pos = jnp.zeros((self.capacity,), jnp.int32)
+            # per-slot sampling state: request seed, absolute token
+            # index (the fold_in counter), and the dynamic sampling
+            # knobs (temperature == 0 -> argmax, top_k == 0 / top_p
+            # == 1 -> disabled) — slot writes at admission, never a
+            # recompile
+            samp = (jnp.zeros((self.capacity,), jnp.int32),
+                    jnp.zeros((self.capacity,), jnp.int32),
+                    jnp.zeros((self.capacity,), jnp.float32),
+                    jnp.zeros((self.capacity,), jnp.int32),
+                    jnp.ones((self.capacity,), jnp.float32))
         # COMMIT the initial state (device_put of an on-device array is
         # a no-op copy-wise but flips it committed): the live loop's
         # state is always committed — its producers take committed
@@ -255,8 +429,10 @@ class DecodeEngine:
         # SECOND compile the first time it sees steady-state inputs,
         # breaking the one-compile-per-(bucket, capacity) invariant
         self._caches = jax.device_put(caches, self._device)
+        self._dcaches = jax.device_put(dcaches, self._device)
         self._tok = jax.device_put(tok, self._device)
         self._pos = jax.device_put(pos, self._device)
+        self._samp = jax.device_put(samp, self._device)
 
         # one AOT-compiled single-step plan plus a halving ladder of
         # fused window plans (step_fuse, step_fuse/2, ... 2) per
@@ -275,13 +451,29 @@ class DecodeEngine:
         self._step_fn: Any = None
         self._stepk_fns: Dict[int, Any] = {}
         self._admit_fns: Dict[int, Any] = {}
+        self._spec_fn: Any = None
+        self._pfxfill_fns: Dict[int, Any] = {}
+        self._pfxadmit_fns: Dict[Tuple[int, int], Any] = {}
+        self._prefix_pool = (_PrefixPool(prefix_pool) if prefix_pool
+                             else None)
         # persistent executable store: resolved once; None keeps every
         # store branch inert.  The plans close over the params, so the
         # weights digest rides every plan fingerprint — two engines
-        # with different weights can never share a store entry.
+        # with different weights can never share a store entry.  The
+        # draft digest and the sampling-static config ride alongside
+        # (large closed-over constants can elide from the HLO text, and
+        # two spec engines differing only in draft weights must never
+        # share a verify executable).
         self._store = _execstore().current()
         self._wdigest = (_execstore().params_digest(self._params)
                          if self._store is not None else None)
+        self._ddigest = (_execstore().params_digest(self._draft_params)
+                         if self._store is not None
+                         and self._draft_params is not None else None)
+        self._samp_cfg = ("samp-v2",
+                          self.spec_tokens
+                          if self._draft_hyper is not None else 0,
+                          bool(self._prefix_pool))
 
         # host-side slot bookkeeping (dispatcher-thread-owned)
         self._slots: List[Optional[_DecodeRequest]] = \
@@ -294,7 +486,10 @@ class DecodeEngine:
         # coalescer's hedge counters)
         self._counters = {"tokens": 0, "steps": 0, "prefills": 0,
                           "admitted": 0, "evicted": 0,
-                          "fused_dispatches": 0}
+                          "fused_dispatches": 0, "sampled_tokens": 0,
+                          "prefix_hits": 0, "prefix_misses": 0,
+                          "prefix_evictions": 0, "spec_windows": 0,
+                          "spec_proposed": 0, "spec_accepted": 0}
         self._bucket_stats: Dict[str, Dict[int, Any]] = {
             "hits": {}, "misses": {}, "compile_time_s": {}}
         self._occupancy = 0
@@ -329,10 +524,39 @@ class DecodeEngine:
                 self._thread.start()
 
     # ---- compiled plans -------------------------------------------------
-    def _step_body(self, caches, tok, pos):
+    def _select(self, logits, samp, offset: int = 0):
+        """Per-slot token selection over (capacity, V) logits: each
+        slot draws with ``fold_in(PRNGKey(seed), step + offset)`` —
+        the absolute-token-index RNG that makes streams independent,
+        replayable, and occupancy-invariant — through the SAME
+        :func:`_sample` implementation the compiled-scan path uses.
+        ``temperature == 0`` slots select the bare argmax
+        (bit-identical to the v1 greedy step).
+
+        Deliberate trade-off: greedy slots ride the same in-graph
+        select, so a pure-greedy dispatch still computes the sampled
+        branch it discards — that is what keeps sampling a STATE
+        write (one step plan at every sampling mix, never a
+        recompile), and the sampled path was engineered cheap (one
+        top_k + one uniform, see ``_sample``) precisely so this dead
+        work stays inside the bench's sampled-vs-greedy overhead
+        bound.  A ``lax.cond`` fast path would shave the greedy step
+        further at the cost of divergent step timing between modes —
+        revisit if a production vocab makes the sort visible next to
+        the transformer step."""
+        seed, stepc, temp, topk, topp = samp
+
+        def pick(lg, s, i, t, k, p):
+            key = jax.random.fold_in(jax.random.PRNGKey(s), i + offset)
+            return _sample(lg, key, t, k, p)
+
+        return jax.vmap(pick)(logits, seed, stepc, temp, topk,
+                              topp).astype(jnp.int32)
+
+    def _step_core(self, caches, tok, pos, samp):
         """ONE slot-array decode step over ALL ``capacity`` slots —
-        the body both step plans trace, so the fused plan is
-        bit-identical to K consecutive single steps by construction.
+        the body the step, fused, and speculative plans all trace, so
+        every plan's per-token numerics are identical by construction.
         Free slots compute garbage that is never read: their (clamped)
         position's cache line is rewritten by the step itself before
         it is attended, and admission overwrites ``[0, bucket)``
@@ -342,8 +566,42 @@ class DecodeEngine:
         posc = jnp.minimum(pos, max_len - 1)
         emb = _embed_token(params, tok, posc)
         logits, caches = _decode_step(params, hyper, caches, emb, posc)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return caches, nxt, jnp.minimum(pos + 1, max_len)
+        nxt = self._select(logits, samp)
+        seed, stepc, temp, topk, topp = samp
+        return (caches, nxt, jnp.minimum(pos + 1, max_len),
+                (seed, stepc + 1, temp, topk, topp))
+
+    def _step_body(self, caches, tok, pos, samp):
+        return self._step_core(caches, tok, pos, samp)
+
+    def _samp_specs(self):
+        s0 = jax.sharding.SingleDeviceSharding(self._device)
+        ispec = jax.ShapeDtypeStruct((self.capacity,), jnp.int32,
+                                     sharding=s0)
+        fspec = jax.ShapeDtypeStruct((self.capacity,), jnp.float32,
+                                     sharding=s0)
+        return (ispec, ispec, fspec, ispec, fspec)
+
+    def _scalar_specs(self):
+        """(seed, temperature, top_k, top_p) admission scalars."""
+        s0 = jax.sharding.SingleDeviceSharding(self._device)
+        i0 = jax.ShapeDtypeStruct((), jnp.int32, sharding=s0)
+        f0 = jax.ShapeDtypeStruct((), jnp.float32, sharding=s0)
+        return (i0, f0, i0, f0)
+
+    def _draft_specs(self):
+        """Draft slot-cache ShapeDtypeStructs ([] without a draft —
+        the plans carry the empty pytree so every engine flavor shares
+        one plan signature)."""
+        if self._draft_hyper is None:
+            return []
+        s0 = jax.sharding.SingleDeviceSharding(self._device)
+        dh = self._draft_hyper
+        dspec = jax.ShapeDtypeStruct(
+            (self.capacity, int(dh["n_heads"]), self.max_len,
+             int(dh["d_model"]) // int(dh["n_heads"])), jnp.float32,
+            sharding=s0)
+        return [(dspec, dspec) for _ in range(int(dh["n_layers"]))]
 
     def _state_specs(self):
         """ShapeDtypeStructs matching the persistent decode state —
@@ -358,7 +616,7 @@ class DecodeEngine:
         ispec = jax.ShapeDtypeStruct((self.capacity,), jnp.int32,
                                      sharding=s0)
         caches = [(cspec, cspec) for _ in range(self._n_layers)]
-        return caches, ispec, ispec
+        return caches, ispec, ispec, self._samp_specs()
 
     def _plan(self, name: str, jitted, arg_specs):
         """AOT-build one decode plan: lower, consult the persistent
@@ -378,7 +636,8 @@ class DecodeEngine:
             es = _execstore()
             fp = store.fingerprint(
                 "decode-plan", name, es.hlo_digest(lowered),
-                self._wdigest, (self.capacity, self.max_len),
+                self._wdigest, self._ddigest, self._samp_cfg,
+                (self.capacity, self.max_len),
                 device=self._device)
             ent = store.lookup(fp)
             if ent is not None:
@@ -401,18 +660,19 @@ class DecodeEngine:
         return compiled
 
     def _build_step_plan(self):
-        """The persistent single-step plan: (caches, tok, pos) ->
-        (caches', tok', pos')."""
+        """The persistent single-step plan: (caches, tok, pos, samp)
+        -> (caches', tok', pos', samp')."""
         # the caches are DONATED: without donation every step copies
         # the whole (capacity, heads, max_len, d_head) cache array per
         # layer just to update one position — the in-place update the
         # scan path gets for free from its loop carry.  Measured ~40%
         # off the per-step wall on CPU; the loop always rebinds the
         # returned caches, so the invalidated buffers are never
-        # touched again.  tok/pos are NOT donated: the pipelined loop
-        # still holds the previous step's token vector for its
+        # touched again.  tok/pos/samp are NOT donated: the pipelined
+        # loop still holds the previous step's token vector for its
         # deferred fetch, and donating would invalidate that buffer
-        # mid-flight (they are (capacity,) ints — the copy is free).
+        # mid-flight (they are (capacity,) scalars — the copy is
+        # free).
         return self._plan(
             "step1", jax.jit(self._step_body, donate_argnums=(0,)),
             self._state_specs())
@@ -429,44 +689,149 @@ class DecodeEngine:
         ``_choose_fuse``), so batching stays iteration-level exactly
         when iteration-level matters."""
 
-        def stepk(caches, tok, pos):
+        def stepk(caches, tok, pos, samp):
             def body(carry, _):
-                c, t, p = carry
-                c, t, p = self._step_body(c, t, p)
-                return (c, t, p), t
+                c, t, p, sm = carry
+                c, t, p, sm = self._step_body(c, t, p, sm)
+                return (c, t, p, sm), t
 
-            (caches, tok, pos), toks = lax.scan(
-                body, (caches, tok, pos), None, length=k)
-            return caches, tok, pos, toks  # toks: (k, capacity)
+            (caches, tok, pos, samp), toks = lax.scan(
+                body, (caches, tok, pos, samp), None, length=k)
+            return caches, tok, pos, samp, toks  # toks: (k, capacity)
 
         return self._plan(f"step{k}",
                           jax.jit(stepk, donate_argnums=(0,)),
                           self._state_specs())
 
+    def _build_spec_plan(self):
+        """The speculative window plan — draft proposal scan, ONE
+        exact target step, windowed verify, and in-graph acceptance,
+        all one dispatch:
+
+            (caches, dcaches, tok, pos, samp) ->
+            (caches', dcaches', tok', pos', samp',
+             T (spec_tokens, capacity), accepted (capacity,))
+
+        ``T[0]`` is the EXACT step's token (the same traced
+        :meth:`_step_core` the non-speculative plan runs, so a full
+        rejection falls back bit-identically); ``T[1:]`` are the
+        window-verified target tokens for the draft's proposals, each
+        selected with its absolute-index fold_in key.  ``accepted``
+        in [1, spec_tokens] counts tokens valid to emit: proposal j is
+        accepted while it equals the previous target token, the
+        standard speculative prefix rule.  The draft scan runs
+        ``spec_tokens - 1`` proposals plus one extra step so the LAST
+        accepted token's draft K/V is written too (an all-accepted
+        window leaves no cache gap).  Rolled-back state (tok', pos')
+        re-derives from ``accepted``, so rejected positions are stale
+        cache lines a later step overwrites before attending — the
+        same write-then-attend invariant free slots rely on."""
+        k = self.spec_tokens
+        params, hyper, max_len = self._params, self._hyper, self.max_len
+        dparams, dhyper = self._draft_params, self._draft_hyper
+
+        def spec(caches, dcaches, tok, pos, samp):
+            def dbody(carry, _):
+                dc, t, p = carry
+                posc = jnp.minimum(p, max_len - 1)
+                emb = _embed_token(dparams, t, posc)
+                lg, dc = _decode_step(dparams, dhyper, dc, emb, posc)
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return (dc, nxt, jnp.minimum(p + 1, max_len)), nxt
+            # k iterations: k-1 proposals + the cache-gap filler (its
+            # proposal is never verified)
+            (dcaches, _, _), dprops = lax.scan(
+                dbody, (dcaches, tok, pos), None, length=k)
+            dprops = dprops[:k - 1]  # (k-1, capacity)
+            # the exact fallback token — bit-identical to the
+            # non-speculative step plan by shared trace
+            caches, t0, _, _ = self._step_core(caches, tok, pos, samp)
+            # windowed verify of the proposals at pos+1 .. pos+k-1
+            embs = [_embed_token(params, dprops[j],
+                                 jnp.minimum(pos + 1 + j, max_len - 1))
+                    for j in range(k - 1)]
+            wlogits, caches = _decode_window(
+                params, hyper, caches, jnp.stack(embs, axis=1),
+                pos + 1)
+            wtoks = [self._select(wlogits[:, j], samp, offset=1 + j)
+                     for j in range(k - 1)]
+            T = jnp.concatenate([t0[None], jnp.stack(wtoks, axis=0)],
+                                axis=0)  # (k, capacity)
+            match = (dprops == T[:k - 1]).astype(jnp.int32)
+            acc = 1 + jnp.cumprod(match, axis=0).sum(axis=0)
+            newtok = jnp.take_along_axis(T, (acc - 1)[None, :],
+                                         axis=0)[0]
+            newpos = jnp.minimum(pos + acc, max_len)
+            seed, stepc, temp, topk, topp = samp
+            samp = (seed, stepc + acc, temp, topk, topp)
+            return caches, dcaches, newtok, newpos, samp, T, acc
+
+        caches, ispec, _, samp = self._state_specs()
+        return self._plan(
+            f"spec{k}", jax.jit(spec, donate_argnums=(0, 1)),
+            (caches, self._draft_specs(), ispec, ispec, samp))
+
     def _ensure_step_plans(self):
-        """Build (or store-load) the step plan + the fused-window
-        ladder — called from warmup(), or lazily at the first
-        dispatch of an unwarmed engine (one ``is None`` check per
-        step thereafter)."""
+        """Build (or store-load) the decode-loop plans — the
+        speculative window plan for a drafted engine, else the step
+        plan + the fused-window ladder — called from warmup(), or
+        lazily at the first dispatch of an unwarmed engine (one
+        ``is None`` check per step thereafter)."""
         if self._step_fn is not None:
+            return
+        if self._draft_hyper is not None:
+            self._spec_fn = self._build_spec_plan()
+            # the built flag: a drafted engine's only step plan IS the
+            # speculative window plan
+            self._step_fn = self._spec_fn
             return
         for k in self._fuse_sizes:
             self._stepk_fns[k] = self._build_stepk_plan(k)
         self._step_fn = self._build_step_plan()  # set LAST: the flag
 
-    def _build_admit_fn(self, s_b: int):
-        """One prompt bucket's admission plan: batched prefill of the
-        (1, s_b) padded prompt, first-token head + argmax, and the
-        K/V insert into slot ``slot`` of the decode state — all one
-        executable, so admitting is a single dispatch."""
-        params, hyper = self._params, self._hyper
+    def _slot_write(self, arrays, slot, tok0, length, seed0, temp0,
+                    topk0, topp0):
+        """Shared admission epilogue: write one slot's (tok, pos,
+        sampling) state — step index starts at 1, the first token's
+        index-0 key having just been consumed."""
+        tok, pos, (seed, stepc, temp, topk, topp) = arrays
+        tok = lax.dynamic_update_slice(tok, tok0[None], (slot,))
+        pos = lax.dynamic_update_slice(
+            pos, length[None].astype(pos.dtype), (slot,))
+        seed = lax.dynamic_update_slice(seed, seed0[None], (slot,))
+        stepc = lax.dynamic_update_slice(
+            stepc, jnp.ones((1,), stepc.dtype), (slot,))
+        temp = lax.dynamic_update_slice(temp, temp0[None], (slot,))
+        topk = lax.dynamic_update_slice(topk, topk0[None], (slot,))
+        topp = lax.dynamic_update_slice(topp, topp0[None], (slot,))
+        return tok, pos, (seed, stepc, temp, topk, topp)
 
-        def admit(caches, tok, pos, prompt, length, slot):
+    def _sample_first(self, logits0, seed0, temp0, topk0, topp0):
+        """First-token selection at absolute index 0 (the same
+        :func:`_sample` + fold_in discipline every later index
+        uses)."""
+        key0 = jax.random.fold_in(jax.random.PRNGKey(seed0), 0)
+        return _sample(logits0, key0, temp0, topk0,
+                       topp0).astype(jnp.int32)
+
+    def _build_admit_fn(self, s_b: int):
+        """One prompt bucket's monolithic admission plan: batched
+        prefill of the (1, s_b) padded prompt, first-token sampling,
+        and the K/V insert into slot ``slot`` of the decode state —
+        all one executable, so admitting is a single dispatch.  A
+        drafted engine's plan also prefills the DRAFT's caches for the
+        prompt (the draft must enter the window in lockstep)."""
+        params, hyper = self._params, self._hyper
+        dparams, dhyper = self._draft_params, self._draft_hyper
+
+        def admit(caches, dcaches, tok, pos, samp, prompt, length,
+                  slot, seed0, temp0, topk0, topp0):
             x, pc = _prefill(params, hyper, prompt, s_b)
             last = lax.dynamic_index_in_dim(x[0], length - 1,
                                             keepdims=False)
             logits0 = _head_logits(params, last[None, :])[0]
-            tok0 = jnp.argmax(logits0, axis=-1).astype(jnp.int32)
+            tok0 = self._sample_first(logits0, seed0, temp0, topk0,
+                                      topp0)
             new_caches = []
             for (ck, cv), (pk, pv) in zip(caches, pc):
                 ck = lax.dynamic_update_slice(
@@ -474,28 +839,150 @@ class DecodeEngine:
                 cv = lax.dynamic_update_slice(
                     cv, pv.astype(cv.dtype), (slot, 0, 0, 0))
                 new_caches.append((ck, cv))
-            tok = lax.dynamic_update_slice(tok, tok0[None], (slot,))
-            pos = lax.dynamic_update_slice(
-                pos, length[None].astype(pos.dtype), (slot,))
-            return new_caches, tok, pos, tok0
+            new_dcaches = dcaches
+            if dhyper is not None:
+                _, dpc = _prefill(dparams, dhyper, prompt, s_b)
+                new_dcaches = []
+                for (ck, cv), (pk, pv) in zip(dcaches, dpc):
+                    ck = lax.dynamic_update_slice(
+                        ck, pk.astype(ck.dtype), (slot, 0, 0, 0))
+                    cv = lax.dynamic_update_slice(
+                        cv, pv.astype(cv.dtype), (slot, 0, 0, 0))
+                    new_dcaches.append((ck, cv))
+            tok, pos, samp = self._slot_write(
+                (tok, pos, samp), slot, tok0, length, seed0, temp0,
+                topk0, topp0)
+            return new_caches, new_dcaches, tok, pos, samp, tok0
 
-        # caches donated for the same in-place-update reason as the
-        # step plan; tok/pos excluded for the same pipeline-aliasing
-        # reason (an admission can run while the previous step's token
-        # vector still awaits its deferred fetch)
-        return jax.jit(admit, donate_argnums=(0,))
+        # caches (target AND draft) donated for the same
+        # in-place-update reason as the step plan; tok/pos/samp
+        # excluded for the same pipeline-aliasing reason (an admission
+        # can run while the previous step's token vector still awaits
+        # its deferred fetch)
+        return jax.jit(admit, donate_argnums=(0, 1))
 
     def _admit_fn_for(self, s_b: int):
         fn = self._admit_fns.get(s_b)
         if fn is None:
-            caches, tok, pos = self._state_specs()
+            caches, tok, pos, samp = self._state_specs()
             s0 = jax.sharding.SingleDeviceSharding(self._device)
             pspec = jax.ShapeDtypeStruct((1, s_b), jnp.int32,
                                          sharding=s0)
             sspec = jax.ShapeDtypeStruct((), jnp.int32, sharding=s0)
             fn = self._admit_fns[s_b] = self._plan(
                 f"admit{s_b}", self._build_admit_fn(s_b),
-                (caches, tok, pos, pspec, sspec, sspec))
+                (caches, self._draft_specs(), tok, pos, samp, pspec,
+                 sspec, sspec) + self._scalar_specs())
+        return fn
+
+    # ---- prefix-KV pool plans -------------------------------------------
+    def _prefix_bucket_for(self, n: int) -> int:
+        """Largest prompt bucket <= n — the bucket-aligned prefix
+        split point for a pool-eligible prompt."""
+        p = self.prompt_buckets[0]
+        for b in self.prompt_buckets:
+            if b <= n:
+                p = b
+        return p
+
+    def _build_pfxfill_fn(self, p_b: int):
+        """The prefix-prefill plan: (1, p_b) prefix ids -> (per-layer
+        (k, v) blocks (1, heads, p_b, d_head), last hidden (d,)).
+        Runs ONCE per distinct prefix content (the pool miss); its
+        outputs are exactly what a pool hit memcpys, which is why hit
+        and miss admissions are bit-identical."""
+        params, hyper = self._params, self._hyper
+
+        def fill(prefix):
+            x, pc = _prefill(params, hyper, prefix, p_b)
+            return pc, x[0, p_b - 1]
+
+        return jax.jit(fill)
+
+    def _pfxfill_fn_for(self, p_b: int):
+        fn = self._pfxfill_fns.get(p_b)
+        if fn is None:
+            s0 = jax.sharding.SingleDeviceSharding(self._device)
+            pspec = jax.ShapeDtypeStruct((1, p_b), jnp.int32,
+                                         sharding=s0)
+            fn = self._pfxfill_fns[p_b] = self._plan(
+                f"pfxfill{p_b}", self._build_pfxfill_fn(p_b),
+                (pspec,))
+        return fn
+
+    def _pfx_block_specs(self, p_b: int):
+        s0 = jax.sharding.SingleDeviceSharding(self._device)
+        h = self._hyper
+        d_head = int(h["d_model"]) // int(h["n_heads"])
+        bspec = jax.ShapeDtypeStruct(
+            (1, int(h["n_heads"]), p_b, d_head), jnp.float32,
+            sharding=s0)
+        hspec = jax.ShapeDtypeStruct((int(h["d_model"]),), jnp.float32,
+                                     sharding=s0)
+        return [(bspec, bspec) for _ in range(self._n_layers)], hspec
+
+    def _build_pfxadmit_fn(self, p_b: int, s_b: int):
+        """The pooled admission plan for (prefix bucket, prompt
+        bucket): ``dynamic_update_slice`` the pooled prefix blocks
+        into the slot (the memcpy), prefill only the TAIL (s_b - p_b
+        padded positions, attending prefix + tail causally), sample
+        the first token, and write the slot state — one executable per
+        (p_b, s_b) pair actually used.  ``length == p_b`` (no tail)
+        admissions reuse the pooled last-hidden for the first token's
+        logits; the p_b == s_b variant compiles without any tail
+        compute at all."""
+        params, hyper = self._params, self._hyper
+        tail_pad = s_b - p_b
+
+        def padmit(caches, tok, pos, samp, pkv, h_pfx, tail, length,
+                   slot, seed0, temp0, topk0, topp0):
+            if tail_pad:
+                xt, tc = _prefill_ext(params, hyper, tail, pkv, p_b)
+            new_caches = []
+            for i, (ck, cv) in enumerate(caches):
+                pk, pv = pkv[i]
+                ck = lax.dynamic_update_slice(
+                    ck, pk.astype(ck.dtype), (slot, 0, 0, 0))
+                cv = lax.dynamic_update_slice(
+                    cv, pv.astype(cv.dtype), (slot, 0, 0, 0))
+                if tail_pad:
+                    tk, tv = tc[i]
+                    ck = lax.dynamic_update_slice(
+                        ck, tk.astype(ck.dtype), (slot, 0, p_b, 0))
+                    cv = lax.dynamic_update_slice(
+                        cv, tv.astype(cv.dtype), (slot, 0, p_b, 0))
+                new_caches.append((ck, cv))
+            if tail_pad:
+                ti = jnp.clip(length - p_b - 1, 0, tail_pad - 1)
+                lh = lax.dynamic_index_in_dim(xt[0], ti,
+                                              keepdims=False)
+                lh = jnp.where(length > p_b, lh, h_pfx)
+            else:
+                lh = h_pfx
+            logits0 = _head_logits(params, lh[None, :])[0]
+            tok0 = self._sample_first(logits0, seed0, temp0, topk0,
+                                      topp0)
+            tok, pos, samp = self._slot_write(
+                (tok, pos, samp), slot, tok0, length, seed0, temp0,
+                topk0, topp0)
+            return new_caches, tok, pos, samp, tok0
+
+        return jax.jit(padmit, donate_argnums=(0,))
+
+    def _pfxadmit_fn_for(self, p_b: int, s_b: int):
+        fn = self._pfxadmit_fns.get((p_b, s_b))
+        if fn is None:
+            caches, tok, pos, samp = self._state_specs()
+            s0 = jax.sharding.SingleDeviceSharding(self._device)
+            blocks, hspec = self._pfx_block_specs(p_b)
+            tspec = jax.ShapeDtypeStruct((1, s_b - p_b), jnp.int32,
+                                         sharding=s0)
+            sspec = jax.ShapeDtypeStruct((), jnp.int32, sharding=s0)
+            fn = self._pfxadmit_fns[(p_b, s_b)] = self._plan(
+                f"pfxadmit{p_b}_{s_b}",
+                self._build_pfxadmit_fn(p_b, s_b),
+                (caches, tok, pos, samp, blocks, hspec, tspec, sspec,
+                 sspec) + self._scalar_specs())
         return fn
 
     def warmup(self) -> float:
@@ -520,6 +1007,8 @@ class DecodeEngine:
         try:
             zero = jax.device_put(np.int32(0), self._device)
             one = jax.device_put(np.int32(1), self._device)
+            fzero = jax.device_put(np.float32(0.0), self._device)
+            fone = jax.device_put(np.float32(1.0), self._device)
             for b in self.prompt_buckets:
                 prompt = jax.device_put(np.zeros((1, b), np.int32),
                                         self._device)
@@ -528,9 +1017,11 @@ class DecodeEngine:
                 # execution; compile_time_s is honest either way
                 tb = time.perf_counter()
                 fn = self._admit_fn_for(b)
-                self._caches, self._tok, self._pos, tok0 = fn(
-                    self._caches, self._tok, self._pos, prompt, one,
-                    zero)
+                (self._caches, self._dcaches, self._tok, self._pos,
+                 self._samp, tok0) = fn(
+                    self._caches, self._dcaches, self._tok, self._pos,
+                    self._samp, prompt, one, zero, zero, fzero, zero,
+                    fone)
                 jax.device_get(tok0)
                 secs = time.perf_counter() - tb
                 self._bucket_stats["compile_time_s"][b] = \
@@ -540,14 +1031,49 @@ class DecodeEngine:
                     self._bucket_stats["misses"].get(b, 0) + 1
                 _slog.info("decode_warmup_bucket", bucket=b,
                            compile_ms=round(secs * 1e3, 3))
+            if self._prefix_pool is not None:
+                # every (prefix bucket, prompt bucket) pair a
+                # pool-eligible prompt can land on: (b_i, b_i) for
+                # exact-bucket prompts, (b_i, b_i+1) for in-between —
+                # warmed here so the live loop never compiles one
+                ladder = self.prompt_buckets
+                for i, p_b in enumerate(ladder):
+                    pfx = jax.device_put(np.zeros((1, p_b), np.int32),
+                                         self._device)
+                    pkv, h_last = self._pfxfill_fn_for(p_b)(pfx)
+                    jax.device_get(h_last)
+                    pairs = [(p_b, p_b)]
+                    if i + 1 < len(ladder):
+                        pairs.append((p_b, ladder[i + 1]))
+                    plen = jax.device_put(np.int32(p_b), self._device)
+                    for pb, sb in pairs:
+                        tail = jax.device_put(
+                            np.zeros((1, sb - pb), np.int32),
+                            self._device)
+                        fn = self._pfxadmit_fn_for(pb, sb)
+                        (self._caches, self._tok, self._pos,
+                         self._samp, tok0) = fn(
+                            self._caches, self._tok, self._pos,
+                            self._samp, pkv, h_last, tail, plen, zero,
+                            zero, fzero, zero, fone)
+                        jax.device_get(tok0)
             self._ensure_step_plans()
-            self._caches, self._tok, self._pos = self._step_fn(
-                self._caches, self._tok, self._pos)
-            jax.device_get(self._tok)
-            for fn in self._stepk_fns.values():
-                self._caches, self._tok, self._pos, toks = fn(
-                    self._caches, self._tok, self._pos)
-                jax.device_get(toks)
+            if self._draft_hyper is not None:
+                (self._caches, self._dcaches, self._tok, self._pos,
+                 self._samp, toks, acc) = self._spec_fn(
+                    self._caches, self._dcaches, self._tok, self._pos,
+                    self._samp)
+                jax.device_get(acc)
+            else:
+                (self._caches, self._tok, self._pos,
+                 self._samp) = self._step_fn(
+                    self._caches, self._tok, self._pos, self._samp)
+                jax.device_get(self._tok)
+                for fn in self._stepk_fns.values():
+                    (self._caches, self._tok, self._pos, self._samp,
+                     toks) = fn(self._caches, self._tok, self._pos,
+                                self._samp)
+                    jax.device_get(toks)
         finally:
             with self._start_cond:
                 self._warming = False
@@ -568,11 +1094,41 @@ class DecodeEngine:
             f"prompt of {n} tokens exceeds the largest prompt bucket "
             f"({self.prompt_buckets[-1]})")
 
-    def _validate(self, prompt_ids, max_new_tokens):
+    @staticmethod
+    def validate_sampling(temperature=0.0, top_k=None, top_p=None,
+                          seed=0):
+        """Sampling-parameter validation (raises ValueError) — shared
+        by every envelope above the engine (the web sample's 400s, the
+        fleet router, ``generate_ex``) so a bad request is rejected
+        identically everywhere.  Returns the normalized
+        (temperature, top_k, top_p, seed)."""
+        t = float(temperature)
+        if not np.isfinite(t) or t < 0.0:
+            raise ValueError(
+                f"temperature must be a finite value >= 0, got "
+                f"{temperature!r}")
+        if top_k is not None:
+            top_k = int(top_k)
+            if top_k < 1:
+                raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None:
+            top_p = float(top_p)
+            if not (0.0 < top_p <= 1.0):
+                raise ValueError(
+                    f"top_p must lie in (0, 1], got {top_p}")
+        seed = int(seed)
+        if not (0 <= seed < 2 ** 31):
+            raise ValueError(
+                f"seed must lie in [0, 2**31), got {seed}")
+        return t, top_k, top_p, seed
+
+    def _validate(self, prompt_ids, max_new_tokens, temperature=0.0,
+                  top_k=None, top_p=None, seed=0):
         """Shared request validation — raises ValueError, mutates
-        nothing: (1-D prompt, length, bucket, max_new).  ``generate``
-        pre-validates EVERY row through this before its first submit,
-        so a bad late row cannot orphan earlier rows mid-decode."""
+        nothing: (1-D prompt, length, bucket, max_new, sampling
+        tuple).  ``generate`` pre-validates EVERY row through this
+        before its first submit, so a bad late row cannot orphan
+        earlier rows mid-decode."""
         prompt = np.asarray(prompt_ids)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]
@@ -589,17 +1145,26 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({L}) + max_new_tokens ({max_new}) exceeds "
                 f"max_len ({self.max_len})")
-        return prompt, L, self.bucket_for(L), max_new
+        samp = self.validate_sampling(temperature, top_k, top_p, seed)
+        return prompt, L, self.bucket_for(L), max_new, samp
 
     def submit(self, prompt_ids, max_new_tokens: int,
-               eos_id: Optional[int] = None, span=None) -> TokenStream:
+               eos_id: Optional[int] = None, span=None,
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               seed: int = 0) -> TokenStream:
         """Queue one prompt for continuous-batching decode; returns its
         :class:`TokenStream` immediately.  ``prompt_ids``: 1-D int ids
         (a (1, L) row is accepted too).  ``eos_id`` overrides the
         engine default; decoding stops at EOS (included in the stream)
-        or after ``max_new_tokens``, whichever is first."""
-        prompt, L, bucket, max_new = self._validate(prompt_ids,
-                                                    max_new_tokens)
+        or after ``max_new_tokens``, whichever is first.
+        ``temperature`` > 0 samples (optionally top-k/top-p truncated)
+        from the per-request ``(seed, token index)`` fold_in stream —
+        resubmitting the same (prompt, sampling params, seed) replays
+        the same tokens regardless of engine occupancy."""
+        prompt, L, bucket, max_new, samp = self._validate(
+            prompt_ids, max_new_tokens, temperature, top_k, top_p,
+            seed)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :L] = prompt
         with self._id_lock:
@@ -612,7 +1177,9 @@ class DecodeEngine:
             span.phase_start("decode_wait")
         req = _DecodeRequest(padded, L, bucket, max_new,
                              self.eos_id if eos_id is None else eos_id,
-                             stream, span)
+                             stream, span, temperature=samp[0],
+                             top_k=samp[1], top_p=samp[2],
+                             seed=samp[3])
         with self._submit_lock:
             if self.closed:
                 raise DecodeEngineClosedError(
@@ -631,12 +1198,15 @@ class DecodeEngine:
         return stream
 
     def generate(self, prompts, max_new_tokens, eos_id=None,
-                 timeout: Optional[float] = None,
-                 span=None) -> List[np.ndarray]:
+                 timeout: Optional[float] = None, span=None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 seed=0) -> List[np.ndarray]:
         """Blocking convenience over :meth:`submit`: decode a batch of
         prompts (a (B, L) array, or a list of 1-D ragged rows) and
         return each row's generated continuation (1-D int32).
-        ``max_new_tokens`` may be per-row (a sequence) or shared.
+        ``max_new_tokens`` and ``seed`` may be per-row (a sequence) or
+        shared; ``temperature``/``top_k``/``top_p`` are shared.
         ``span`` rides the request when there is exactly one row (a
         span is single-owner; batch rows would interleave phases)."""
         rows = ([np.asarray(prompts[i]) for i in range(len(prompts))]
@@ -650,14 +1220,24 @@ class DecodeEngine:
                 raise ValueError(
                     f"max_new_tokens has {len(max_news)} entries for "
                     f"{len(rows)} prompts")
+        if np.ndim(seed) == 0:
+            seeds = [int(seed)] * len(rows)
+        else:
+            seeds = [int(s) for s in seed]
+            if len(seeds) != len(rows):
+                raise ValueError(
+                    f"seed has {len(seeds)} entries for "
+                    f"{len(rows)} prompts")
         # all-or-nothing: validate EVERY row before the first submit,
         # so a bad late row can't leave earlier rows decoding into
         # abandoned streams (burning slots the caller gave up on)
-        for r, m in zip(rows, max_news):
-            self._validate(r, m)
+        for r, m, s in zip(rows, max_news, seeds):
+            self._validate(r, m, temperature, top_k, top_p, s)
         streams = [self.submit(r, m, eos_id=eos_id,
-                               span=span if len(rows) == 1 else None)
-                   for r, m in zip(rows, max_news)]
+                               span=span if len(rows) == 1 else None,
+                               temperature=temperature, top_k=top_k,
+                               top_p=top_p, seed=s)
+                   for (r, m, s) in zip(rows, max_news, seeds)]
         return [s.result(timeout=timeout) for s in streams]
 
     # ---- stats ----------------------------------------------------------
@@ -673,6 +1253,15 @@ class DecodeEngine:
                    prefill_misses=dict(self._bucket_stats["misses"]),
                    prefill_compile_time_s=dict(
                        self._bucket_stats["compile_time_s"]))
+        pool = self._prefix_pool
+        out["prefix_pool_size"] = pool.size if pool is not None else 0
+        out["prefix_pool_entries"] = (len(pool.entries)
+                                      if pool is not None else 0)
+        out["spec_enabled"] = self._draft_hyper is not None
+        proposed = out.get("spec_proposed", 0)
+        out["spec_acceptance"] = (
+            round(out.get("spec_accepted", 0) / proposed, 4)
+            if proposed else None)
         return out
 
     # ---- dispatcher -----------------------------------------------------
@@ -703,14 +1292,23 @@ class DecodeEngine:
             self._flush_queue(DecodeEngineClosedError(
                 "DecodeEngine closed"))
 
-    def _admit_slot(self, req: _DecodeRequest, slot: int):
-        """Admit one queued request into ``slot``: run its bucket's
-        prefill+insert plan, stream the first token, and activate the
-        slot — or finish the request immediately when the first token
-        already ends it (EOS / max_new == 1)."""
-        span = req.span
-        if span is not None:
-            span.phase_start("prefill")
+    def _samp_scalars(self, req: _DecodeRequest):
+        """The request's sampling scalars as committed device values —
+        explicit device_put like every other host->device hop in the
+        loop (a bare python float into a jit is an implicit transfer
+        of its own)."""
+        return (jax.device_put(np.int32(req.seed), self._device),
+                jax.device_put(np.float32(req.temperature),
+                               self._device),
+                jax.device_put(np.int32(req.top_k or 0), self._device),
+                jax.device_put(np.float32(1.0 if req.top_p is None
+                                          else req.top_p),
+                               self._device))
+
+    def _admit_monolithic(self, req: _DecodeRequest, slot: int) -> int:
+        """The single-plan admission: one prefill+insert dispatch for
+        the whole padded prompt (the v1 path — every engine without a
+        prefix pool, and pool-ineligible short prompts)."""
         fresh = req.bucket not in self._admit_fns
         stat = ("misses" if (fresh
                              and req.bucket
@@ -724,25 +1322,103 @@ class DecodeEngine:
         t0 = time.perf_counter()
         fn = self._admit_fn_for(req.bucket)
         # every host->device hop is explicit (device_put), so the loop
-        # stays clean under zoolint.sanitize() transfer guards — the
-        # scalars included (a bare python int into a jit is an
-        # implicit transfer of its own)
+        # stays clean under zoolint.sanitize() transfer guards
         prompt_dev = jax.device_put(req.prompt, self._device)
         length_dev = jax.device_put(np.int32(req.length), self._device)
         slot_dev = jax.device_put(np.int32(slot), self._device)
+        scalars = self._samp_scalars(req)
         _profile.note_transfer("h2d")
-        self._caches, self._tok, self._pos, tok0 = fn(
-            self._caches, self._tok, self._pos, prompt_dev,
-            length_dev, slot_dev)
+        (self._caches, self._dcaches, self._tok, self._pos,
+         self._samp, tok0) = fn(
+            self._caches, self._dcaches, self._tok, self._pos,
+            self._samp, prompt_dev, length_dev, slot_dev, *scalars)
         tok0 = int(jax.device_get(tok0))
         _profile.note_transfer("d2h")
         if fresh:
             self._bucket_stats["compile_time_s"][req.bucket] = \
                 self._bucket_stats["compile_time_s"].get(
                     req.bucket, 0.0) + (time.perf_counter() - t0)
+        return tok0
+
+    def _prefix_lookup(self, key: str) -> Optional[_PrefixEntry]:
+        """Prefix-pool read — hot: once per pool-eligible admission;
+        a miss is the signal to recompute (and re-pool) the block."""
+        ent = self._prefix_pool.get(key)
+        if ent is None:
+            self._counters["prefix_misses"] += 1
+        else:
+            self._counters["prefix_hits"] += 1
+        return ent
+
+    def _admit_prefix(self, req: _DecodeRequest, slot: int) -> int:
+        """Pool-eligible admission: split the prompt at its largest
+        bucket boundary, serve the prefix block from the pool (or
+        recompute + pool it), and run the (prefix, bucket) pair's
+        memcpy+tail plan.  Hit or miss, the tail plan consumes
+        bit-identical prefix blocks, so the streams cannot differ."""
+        p_b = self._prefix_bucket_for(req.length)
+        s_b = req.bucket
+        # same fresh-compile accounting as the monolithic path: an
+        # unwarmed engine's inline pfxfill/pfxadmit builds count as a
+        # bucket MISS with their compile time recorded, never as a hit
+        fresh = ((p_b, s_b) not in self._pfxadmit_fns
+                 or p_b not in self._pfxfill_fns)
+        stat = ("misses" if (fresh
+                             and s_b
+                             not in self._bucket_stats["misses"])
+                else "hits")
+        self._bucket_stats[stat][s_b] = \
+            self._bucket_stats[stat].get(s_b, 0) + 1
+        t0 = time.perf_counter()
+        key = _PrefixPool.key(req.prompt[0, :p_b])
+        ent = self._prefix_lookup(key)
+        if ent is None:
+            pfx_dev = jax.device_put(
+                np.ascontiguousarray(req.prompt[:, :p_b]),
+                self._device)
+            _profile.note_transfer("h2d")
+            pkv, h_last = self._pfxfill_fn_for(p_b)(pfx_dev)
+            ent = _PrefixEntry(pkv, h_last, p_b)
+            self._counters["prefix_evictions"] += \
+                self._prefix_pool.put(key, ent)
+        fn = self._pfxadmit_fn_for(p_b, s_b)
+        tail = np.zeros((1, s_b - p_b), np.int32)
+        tail[0, :req.length - p_b] = req.prompt[0, p_b:req.length]
+        tail_dev = jax.device_put(tail, self._device)
+        length_dev = jax.device_put(np.int32(req.length), self._device)
+        slot_dev = jax.device_put(np.int32(slot), self._device)
+        scalars = self._samp_scalars(req)
+        _profile.note_transfer("h2d")
+        (self._caches, self._tok, self._pos, self._samp, tok0) = fn(
+            self._caches, self._tok, self._pos, self._samp, ent.kv,
+            ent.h_last, tail_dev, length_dev, slot_dev, *scalars)
+        tok0 = int(jax.device_get(tok0))
+        _profile.note_transfer("d2h")
+        if fresh:
+            self._bucket_stats["compile_time_s"][s_b] = \
+                self._bucket_stats["compile_time_s"].get(s_b, 0.0) \
+                + (time.perf_counter() - t0)
+        return tok0
+
+    def _admit_slot(self, req: _DecodeRequest, slot: int):
+        """Admit one queued request into ``slot``: run its admission
+        plan (monolithic, or prefix-pooled when eligible), stream the
+        first token, and activate the slot — or finish the request
+        immediately when the first token already ends it (EOS /
+        max_new == 1)."""
+        span = req.span
+        if span is not None:
+            span.phase_start("prefill")
+        if (self._prefix_pool is not None
+                and req.length >= self.prompt_buckets[0]):
+            tok0 = self._admit_prefix(req, slot)
+        else:
+            tok0 = self._admit_monolithic(req, slot)
         self._counters["prefills"] += 1
         self._counters["admitted"] += 1
         self._counters["tokens"] += 1
+        if req.temperature > 0.0:
+            self._counters["sampled_tokens"] += 1
         req.produced = 1
         req.scheduled = 1
         req.stream._push(tok0)
@@ -813,48 +1489,69 @@ class DecodeEngine:
         of this dispatch — the fetch side fans tokens out against the
         snapshot, so an eviction or admission that happens while the
         device computes cannot mis-route a token.  Returns
-        (token vector or (k, capacity) matrix, snapshot, window)."""
+        (token vector or (k, capacity) matrix, acceptance vector or
+        None, snapshot, window)."""
         if self._step_fn is None:
             # unwarmed engine: build (or store-load) the step plans
             # inline, once — warmed engines pay one is-None check
             self._ensure_step_plans()
+        if self._draft_hyper is not None:
+            return self._dispatch_spec()
         k = self._choose_fuse()
         if k > 1:
-            self._caches, self._tok, self._pos, toks = \
-                self._stepk_fns[k](self._caches, self._tok, self._pos)
+            (self._caches, self._tok, self._pos, self._samp,
+             toks) = self._stepk_fns[k](self._caches, self._tok,
+                                        self._pos, self._samp)
             self._counters["fused_dispatches"] += 1
         else:
-            self._caches, self._tok, self._pos = self._step_fn(
-                self._caches, self._tok, self._pos)
+            (self._caches, self._tok, self._pos,
+             self._samp) = self._step_fn(self._caches, self._tok,
+                                         self._pos, self._samp)
             toks = self._tok
         self._counters["steps"] += k
         for req in self._slots:
             if req is not None:
                 req.scheduled += k
-        return toks, list(self._slots), k
+        return toks, None, list(self._slots), k
 
-    def _process_step(self, pending):
-        """Fetch a dispatched window's token vector ((capacity,) for a
-        single step, (K, capacity) fused) and fan it out to the slots
-        that were live AT DISPATCH TIME, evicting finished ones.  A
-        request that finished in an EARLIER window's processing (the
-        pipeline dispatches window k+1 before window k is processed,
-        so its snapshot can still name it) is skipped — its stream is
-        closed and the slot's extra computed tokens are garbage by
-        construction, as are any tokens past a request's max_new/EOS
-        inside a fused window."""
-        tok_dev, snapshot, k = pending
-        toks = jax.device_get(tok_dev)
-        _profile.note_transfer("d2h")
-        if k == 1:
-            toks = toks.reshape(1, -1)
+    def _dispatch_spec(self):
+        """Dispatch one speculative window (draft scan + exact step +
+        verify, ONE executable) — same snapshot discipline as
+        :meth:`_dispatch_step`; the acceptance vector rides the
+        pending tuple so the fetch side knows how many of each slot's
+        ``spec_tokens`` candidates are valid."""
+        k = self.spec_tokens
+        (self._caches, self._dcaches, self._tok, self._pos,
+         self._samp, toks, acc) = self._spec_fn(
+            self._caches, self._dcaches, self._tok, self._pos,
+            self._samp)
+        self._counters["steps"] += k
+        self._counters["spec_windows"] += 1
+        for req in self._slots:
+            if req is not None:
+                req.scheduled += k
+        return toks, acc, list(self._slots), k
+
+    def _push_window(self, snapshot, toks, counts):
+        """Fan one fetched window out to the slots live at dispatch
+        time, evicting finished requests: ``toks`` is (k, capacity),
+        ``counts[slot]`` how many of the k rows are valid for that
+        slot.  A request that finished in an EARLIER window's
+        processing (the pipeline dispatches window n+1 before window n
+        is processed, so its snapshot can still name it) is skipped —
+        its stream is closed and the slot's extra computed tokens are
+        garbage by construction, as are any tokens past a request's
+        max_new/EOS inside a window."""
         for slot, req in enumerate(snapshot):
             if req is None or req.stream.done:
                 continue
-            for j in range(k):
+            sampled = req.temperature > 0.0
+            for j in range(counts[slot]):
                 tok = int(toks[j, slot])
                 req.produced += 1
                 self._counters["tokens"] += 1
+                if sampled:
+                    self._counters["sampled_tokens"] += 1
                 req.stream._push(tok)
                 if (req.produced >= req.max_new
                         or (req.eos_id is not None
@@ -867,6 +1564,40 @@ class DecodeEngine:
                     self._slots[slot] = None
                     self._free.append(slot)
                     break
+
+    def _process_step(self, pending):
+        """Fetch a dispatched window ((capacity,) single step,
+        (K, capacity) fused) and fan it out against its snapshot."""
+        tok_dev, acc_dev, snapshot, k = pending
+        if acc_dev is not None:
+            return self._process_spec(pending)
+        toks = jax.device_get(tok_dev)
+        _profile.note_transfer("d2h")
+        if k == 1:
+            toks = toks.reshape(1, -1)
+        self._push_window(snapshot, toks, [k] * self.capacity)
+
+    def _process_spec(self, pending):
+        """Fetch a speculative window's (spec_tokens, capacity)
+        candidate matrix + acceptance vector and fan out each slot's
+        ACCEPTED tokens (at least the exact fallback token, at most
+        the whole window) — the verify loop's host half, hot once per
+        window."""
+        tok_dev, acc_dev, snapshot, k = pending
+        toks = jax.device_get(tok_dev)
+        acc = jax.device_get(acc_dev)
+        _profile.note_transfer("d2h")
+        counts = [0] * self.capacity
+        for slot, req in enumerate(snapshot):
+            if req is None or req.stream.done:
+                continue
+            counts[slot] = int(acc[slot])
+            # acceptance accounting covers live slots only — free
+            # slots compute garbage windows that must not dilute the
+            # reported acceptance rate
+            self._counters["spec_proposed"] += k - 1
+            self._counters["spec_accepted"] += int(acc[slot]) - 1
+        self._push_window(snapshot, toks, counts)
 
     def _decode_loop(self):
         try:

@@ -73,7 +73,10 @@ class InferenceModel:
                  decode_capacity: Optional[int] = None,
                  decode_max_len: Optional[int] = None,
                  decode_prompt_buckets: Optional[Sequence[int]] = None,
-                 decode_eos_id: Optional[int] = None):
+                 decode_eos_id: Optional[int] = None,
+                 decode_prefix_pool: int = 0,
+                 decode_draft=None,
+                 decode_spec_tokens: int = 4):
         """``supported_concurrent_num`` bounds concurrent device work
         (reference semantics; PER REPLICA when replicated — the
         effective bound scales with the replica count).  The serving
@@ -116,6 +119,12 @@ class InferenceModel:
           The engine is warmed at load — every (bucket, capacity)
           plan compiles before the handle serves, never under a live
           stream.
+        * ``decode_prefix_pool`` — > 0 enables the engine's on-device
+          prefix-KV LRU pool with that many entries (shared-prefix
+          admissions skip the prefix prefill; decode.py module doc).
+        * ``decode_draft`` — a small generation-capable draft net (or
+          a ``(params, hyper)`` pair) enables speculative decoding of
+          up to ``decode_spec_tokens`` tokens per dispatch.
         """
         self.concurrent_num = int(supported_concurrent_num)
         self._semaphore = threading.Semaphore(self.concurrent_num)
@@ -139,6 +148,9 @@ class InferenceModel:
         self._decode_max_len = decode_max_len
         self._decode_prompt_buckets = decode_prompt_buckets
         self._decode_eos_id = decode_eos_id
+        self._decode_prefix_pool = int(decode_prefix_pool)
+        self._decode_draft = decode_draft
+        self._decode_spec_tokens = int(decode_spec_tokens)
         self._decode_engine: Optional[DecodeEngine] = None
         self._cache: Optional[BucketedExecutableCache] = None
         self._coalescer: Optional[RequestCoalescer] = None
@@ -212,12 +224,24 @@ class InferenceModel:
             raise ValueError(
                 "decode_capacity is not supported for quantized "
                 "handles (the decode math reads float params by name)")
+        draft_params = draft_hyper = None
+        draft = self._decode_draft
+        if draft is not None:
+            if isinstance(draft, tuple):
+                draft_params, draft_hyper = draft
+            else:
+                dtrainer = draft.ensure_inference_ready()
+                draft_params = dtrainer.state.params
+                draft_hyper = draft.hyper
         engine = DecodeEngine(
             trainer.state.params, hyper,
             capacity=self._decode_capacity,
             max_len=self._decode_max_len,
             prompt_buckets=self._decode_prompt_buckets,
-            eos_id=self._decode_eos_id)
+            eos_id=self._decode_eos_id,
+            prefix_pool=self._decode_prefix_pool,
+            draft_params=draft_params, draft_hyper=draft_hyper,
+            spec_tokens=self._decode_spec_tokens)
         engine.warmup()
         return engine
 
@@ -475,28 +499,40 @@ class InferenceModel:
 
     def generate(self, prompt_ids, max_new_tokens,
                  eos_id: Optional[int] = None,
-                 timeout: Optional[float] = None):
-        """Continuous-batching greedy decode: each prompt (a (B, L)
-        array or a list of ragged 1-D id rows) is bucketed, prefilled,
-        and slot-scheduled per decode step alongside every other live
+                 timeout: Optional[float] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, seed=0):
+        """Continuous-batching decode: each prompt (a (B, L) array or
+        a list of ragged 1-D id rows) is bucketed, prefilled, and
+        slot-scheduled per decode step alongside every other live
         request — a short request never pays a long neighbor's latency.
         Returns each row's generated continuation (list of 1-D int32
-        arrays; EOS included when hit).  ``max_new_tokens`` may be
-        per-row.  Token-identical to ``TransformerLM.generate``'s
-        compiled scan for the same prompt."""
+        arrays; EOS included when hit).  ``max_new_tokens`` (and
+        ``seed``) may be per-row.  Greedy (``temperature == 0``,
+        default) is token-identical to ``TransformerLM.generate``'s
+        compiled scan for the same prompt; ``temperature > 0`` samples
+        (top-k/top-p truncated) from the per-request ``(seed, token
+        index)`` fold_in stream — same request, same stream, at any
+        engine occupancy."""
         return self._require_engine().generate(
             prompt_ids, max_new_tokens, eos_id=eos_id, timeout=timeout,
-            span=_trace.current_span())
+            span=_trace.current_span(), temperature=temperature,
+            top_k=top_k, top_p=top_p, seed=seed)
 
     def generate_stream(self, prompt_ids, max_new_tokens: int,
-                        eos_id: Optional[int] = None):
+                        eos_id: Optional[int] = None,
+                        temperature: float = 0.0,
+                        top_k: Optional[int] = None,
+                        top_p: Optional[float] = None, seed: int = 0):
         """Streaming single-prompt decode: returns a
         :class:`~.decode.TokenStream` immediately — iterate it for
         per-token delivery, or ``.result()`` for the full
         continuation."""
         span = _trace.current_span()
         return self._require_engine().submit(
-            prompt_ids, max_new_tokens, eos_id=eos_id, span=span)
+            prompt_ids, max_new_tokens, eos_id=eos_id, span=span,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            seed=seed)
 
     def close(self):
         """Stop the coalescer and decode dispatcher threads (no-op

@@ -57,11 +57,16 @@ def lm(args: Dict[str, Any], params: Optional[Dict[str, Any]]
         d_model=int(args.get("d_model", 16)),
         n_heads=int(args.get("n_heads", 2)))
     net.ensure_inference_ready()
-    return {"net": net,
-            "decode_capacity": int(args.get("capacity", 2)),
-            "decode_prompt_buckets": tuple(
-                args.get("prompt_buckets", (8,))),
-            "replicas": 1}
+    out = {"net": net,
+           "decode_capacity": int(args.get("capacity", 2)),
+           "decode_prompt_buckets": tuple(
+               args.get("prompt_buckets", (8,))),
+           "replicas": 1}
+    # decode engine v2 knobs ride the artifact spec (json scalars), so
+    # a fleet-wide deploy configures every worker's engine identically
+    if args.get("prefix_pool"):
+        out["decode_prefix_pool"] = int(args["prefix_pool"])
+    return out
 
 
 class StubModel:

@@ -12,7 +12,13 @@ The payload is the existing control-plane envelope verbatim:
 
 * requests — ``{"op", "id", ...op fields}`` where the op fields are
   exactly the ``predict_ex``/``generate_ex`` keyword surface
-  (``model``, ``deadline_ms``, ``trace_id``, ``priority_class``) plus
+  (``model``, ``deadline_ms``, ``trace_id``, ``priority_class``, and
+  for generate the sampling envelope ``temperature``/``top_k``/
+  ``top_p``/``seed`` — plain json scalars, so cross-process
+  determinism reduces to the engine's process-free fold_in RNG: the
+  same request through any worker replays the single-process
+  registry's tokens bit-exactly, re-gated by
+  tests/test_fleet.py::test_cross_process_generate_determinism) plus
   the fleet control ops (``activate``, ``promote``, ``metrics``,
   ``ping``, ``shutdown``);
 * responses — ``{"id", "ok": true, "result", "info"}`` on success, or

@@ -390,13 +390,24 @@ class FleetRouter:
                     deadline_ms: Optional[float] = None,
                     trace_id: Optional[str] = None,
                     priority_class: Optional[str] = None,
-                    eos_id: Optional[int] = None
+                    eos_id: Optional[int] = None,
+                    temperature: float = 0.0,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None, seed: int = 0
                     ) -> Tuple[Any, Dict[str, Any]]:
+        # sampling params ride the envelope as json-safe scalars
+        # (validated worker-side by the engine, so a bad value comes
+        # back as the concrete ValueError, not a dead connection);
+        # determinism contract: same (prompt, sampling, seed) on any
+        # worker == the single-process registry, bit-exact
         return self._serve_ex(
             {"op": "generate",
              "prompt_ids": protocol.encode_value(prompt_ids),
              "model": model, "max_new_tokens": int(max_new_tokens),
-             "eos_id": eos_id},
+             "eos_id": eos_id, "temperature": float(temperature),
+             "top_k": None if top_k is None else int(top_k),
+             "top_p": None if top_p is None else float(top_p),
+             "seed": int(seed)},
             model, "generate", deadline_ms, trace_id, priority_class)
 
     def _serve_ex(self, req: Dict[str, Any], model: str, op: str,

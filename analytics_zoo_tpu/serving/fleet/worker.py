@@ -220,12 +220,19 @@ class ServingWorker:
             return {"result": protocol.encode_value(out), "info": info}
         if op == "generate":
             prompts = protocol.decode_value(req["prompt_ids"])
+            # sampling params cross the wire as json scalars; the same
+            # (prompt, sampling, seed) through any worker of this
+            # artifact replays the single-process registry's tokens
+            # bit-exactly (the engine's fold_in RNG is process-free)
             out, info = self.registry.generate_ex(
                 req["model"], prompts, req["max_new_tokens"],
                 deadline_ms=req.get("deadline_ms"),
                 trace_id=req.get("trace_id"),
                 priority_class=req.get("priority_class"),
-                eos_id=req.get("eos_id"))
+                eos_id=req.get("eos_id"),
+                temperature=req.get("temperature", 0.0),
+                top_k=req.get("top_k"), top_p=req.get("top_p"),
+                seed=req.get("seed", 0))
             return {"result": protocol.encode_value(out), "info": info}
         fn = self._control.get(op)
         if fn is None:

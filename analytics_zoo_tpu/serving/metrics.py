@@ -78,10 +78,21 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
     }
     # continuous-batching decode: per-token/step counters + the live
     # slot-occupancy gauge (capacity alongside, so occupancy reads as
-    # a fraction without a dashboard join)
+    # a fraction without a dashboard join).  Decode engine v2 adds the
+    # sampled-token counter, the prefix-pool hit/miss pair (their
+    # ratio is the shared-prefix win), and the speculative
+    # proposed/accepted pair (their ratio is the acceptance rate the
+    # spec bench gates on) — exported whenever a decode engine is
+    # live, zeros until the feature serves traffic, so dashboards and
+    # alerts can pre-wire at deploy
     decode_counters: Dict[str, List] = {
         "zoo_decode_tokens_total": [],
         "zoo_decode_steps_total": [],
+        "zoo_decode_sampled_tokens_total": [],
+        "zoo_decode_prefix_hits_total": [],
+        "zoo_decode_prefix_misses_total": [],
+        "zoo_decode_spec_proposed_total": [],
+        "zoo_decode_spec_accepted_total": [],
     }
     decode_gauges: Dict[str, List] = {
         "zoo_decode_slot_occupancy": [],
@@ -162,10 +173,20 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
                 (ml, serving["coalescer_pending"]))
         dec = serving.get("decode")
         if dec:
-            decode_counters["zoo_decode_tokens_total"].append(
-                (ml, dec.get("tokens", 0)))
-            decode_counters["zoo_decode_steps_total"].append(
-                (ml, dec.get("steps", 0)))
+            for prom_name, key in (
+                    ("zoo_decode_tokens_total", "tokens"),
+                    ("zoo_decode_steps_total", "steps"),
+                    ("zoo_decode_sampled_tokens_total",
+                     "sampled_tokens"),
+                    ("zoo_decode_prefix_hits_total", "prefix_hits"),
+                    ("zoo_decode_prefix_misses_total",
+                     "prefix_misses"),
+                    ("zoo_decode_spec_proposed_total",
+                     "spec_proposed"),
+                    ("zoo_decode_spec_accepted_total",
+                     "spec_accepted")):
+                decode_counters[prom_name].append(
+                    (ml, dec.get(key, 0)))
             decode_gauges["zoo_decode_slot_occupancy"].append(
                 (ml, dec.get("slots_active", 0)))
             decode_gauges["zoo_decode_slot_capacity"].append(
@@ -246,6 +267,19 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
             "engine (prefill first tokens included)",
         "zoo_decode_steps_total":
             "slot-array decode steps dispatched",
+        "zoo_decode_sampled_tokens_total":
+            "tokens emitted by temperature > 0 (sampled) requests",
+        "zoo_decode_prefix_hits_total":
+            "admissions whose prefix-KV block was served from the "
+            "on-device pool (prefill skipped for the prefix)",
+        "zoo_decode_prefix_misses_total":
+            "pool-eligible admissions that recomputed (and "
+            "re-pooled) their prefix block",
+        "zoo_decode_spec_proposed_total":
+            "draft tokens proposed to the speculative verify step",
+        "zoo_decode_spec_accepted_total":
+            "draft proposals accepted by the target verify "
+            "(accepted/proposed = acceptance rate)",
         "zoo_decode_slot_occupancy":
             "decode slots currently holding a live sequence",
         "zoo_decode_slot_capacity":

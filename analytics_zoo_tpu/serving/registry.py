@@ -432,34 +432,49 @@ class ModelRegistry:
     def generate(self, name: str, prompt_ids, max_new_tokens,
                  deadline_ms: Optional[float] = None,
                  priority_class: Optional[str] = None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 temperature: float = 0.0,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, seed=0):
         out, _ = self.generate_ex(name, prompt_ids, max_new_tokens,
                                   deadline_ms=deadline_ms,
                                   priority_class=priority_class,
-                                  eos_id=eos_id)
+                                  eos_id=eos_id,
+                                  temperature=temperature, top_k=top_k,
+                                  top_p=top_p, seed=seed)
         return out
 
     def generate_ex(self, name: str, prompt_ids, max_new_tokens,
                     deadline_ms: Optional[float] = None,
                     trace_id: Optional[str] = None,
                     priority_class: Optional[str] = None,
-                    eos_id: Optional[int] = None
+                    eos_id: Optional[int] = None,
+                    temperature: float = 0.0,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None, seed=0
                     ) -> Tuple[Any, Dict[str, Any]]:
         """The continuous-batching generate path: same admission /
         routing / counters / span discipline as :meth:`predict_ex`,
         but the data plane is the model's ``DecodeEngine`` — the
         request joins the live slot array at the next decode step and
         streams until EOS or ``max_new_tokens``.  Returns (list of
-        per-row continuation arrays, routing info).  The admission
-        slot is held for the whole decode: a decoding request IS
-        in-flight work, and releasing early would let max_concurrency
-        overcommit the engine's queue.  Requires the deployment to
-        have been built with ``decode_capacity`` (raises
-        RuntimeError otherwise)."""
+        per-row continuation arrays, routing info).
+        ``temperature``/``top_k``/``top_p``/``seed`` select per-slot
+        sampling (greedy by default); a fixed (prompt, sampling,
+        seed) tuple replays the same tokens on ANY deployment of the
+        same weights — in this process or a fleet worker's.  The
+        admission slot is held for the whole decode: a decoding
+        request IS in-flight work, and releasing early would let
+        max_concurrency overcommit the engine's queue.  Requires the
+        deployment to have been built with ``decode_capacity``
+        (raises RuntimeError otherwise)."""
         return self._serve_ex(
             name, "generate",
             lambda model: model.generate(prompt_ids, max_new_tokens,
-                                         eos_id=eos_id),
+                                         eos_id=eos_id,
+                                         temperature=temperature,
+                                         top_k=top_k, top_p=top_p,
+                                         seed=seed),
             deadline_ms=deadline_ms, trace_id=trace_id,
             priority_class=priority_class)
 

@@ -74,7 +74,13 @@ DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        # (pick + wire call + retry-on-sibling) and the
                        # worker's per-connection request/reply loop
                        # both run once per fleet request
-                       "_route_call", "_serve_conn")
+                       "_route_call", "_serve_conn",
+                       # decode engine v2: the prefix-pool lookup runs
+                       # once per pool-eligible admission, and the
+                       # speculative window's host fan-out once per
+                       # verify dispatch — a stray sync or free-text
+                       # log in either taxes every admission / window
+                       "_prefix_lookup", "_process_spec")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

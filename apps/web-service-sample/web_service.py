@@ -14,9 +14,16 @@ POST /predict {"instances": [[...], ...],              -> {"predictions": [...],
                "class": "interactive"}   # optional priority class
 POST /generate {"prompt": [ids] | [[ids], ...],        -> {"tokens": [[...], ...],
                 "max_new_tokens": 8,                       "model": ..., "version": ...,
-                "model": "lm"}           # optional        "request_id": ...}
+                "model": "lm",           # optional        "request_id": ...}
+                "temperature": 0.8,      # optional, default 0 = greedy
+                "top_k": 20,             # optional truncation
+                "top_p": 0.95,           # optional nucleus truncation
+                "seed": 7}               # replay seed, default 0
                # continuous-batching decode: requests share the slot
-               # array per decode step (see docs/serving.md)
+               # array per decode step (see docs/serving.md).  A fixed
+               # (prompt, sampling, seed) replays the same tokens at
+               # any occupancy; bad sampling values are a 400 with the
+               # engine's ValueError message
 POST /deploy  {"model": "default", "seed": 1,          -> {"model": ..., "version": v}
                "hidden": 16, "canary_fraction": 0.2}   # canary optional
 POST /promote {"model": "default"}                     -> {"version": v}
@@ -245,12 +252,25 @@ def make_handler(registry, obs=None):
                     prompt = np.asarray(payload["prompt"], dtype=np.int32)
                     if prompt.ndim == 1:
                         prompt = prompt[None, :]
+                    # validate sampling BEFORE admission so a bad
+                    # request 400s without consuming a slot — the
+                    # same check the engine re-runs at submit
+                    from analytics_zoo_tpu.pipeline.inference.decode \
+                        import DecodeEngine
+                    temp, top_k, top_p, seed = \
+                        DecodeEngine.validate_sampling(
+                            payload.get("temperature", 0.0),
+                            payload.get("top_k"),
+                            payload.get("top_p"),
+                            payload.get("seed", 0))
                     toks, info = registry.generate_ex(
                         payload.get("model", LM_MODEL), prompt,
                         int(payload.get("max_new_tokens", 8)),
                         deadline_ms=payload.get("deadline_ms"),
                         trace_id=rid,
-                        priority_class=payload.get("class"))
+                        priority_class=payload.get("class"),
+                        temperature=temp, top_k=top_k, top_p=top_p,
+                        seed=seed)
                     self._reply(200, {
                         "tokens": [np.asarray(t).tolist() for t in toks],
                         **info}, headers={"X-Request-Id": rid})
@@ -447,6 +467,32 @@ def self_test(port: int):
     print(f"generate check: {LM_MODEL} streamed "
           f"{len(g1['tokens'][0])} tokens deterministically, decode "
           "span phases present OK")
+
+    # ---- decode engine v2: sampled generation replays bit-identically
+    # at a fixed (prompt, sampling params, seed), and bad sampling
+    # values are a structured 400, never an admitted request
+    sampled_req = {"prompt": lm_prompt, "max_new_tokens": 6,
+                   "temperature": 0.9, "top_k": 12, "top_p": 0.95,
+                   "seed": 1234}
+    sg1 = call("/generate", dict(sampled_req))
+    sg2 = call("/generate", dict(sampled_req))
+    assert len(sg1["tokens"]) == 1 and len(sg1["tokens"][0]) == 6, sg1
+    assert sg1["tokens"] == sg2["tokens"], (sg1, sg2)
+    from urllib.error import HTTPError
+    for bad in ({"temperature": -1}, {"temperature": "nan"},
+                {"top_k": 0}, {"top_p": 1.5}, {"seed": -3}):
+        try:
+            call("/generate", {"prompt": lm_prompt,
+                               "max_new_tokens": 4, **bad})
+        except HTTPError as e:
+            assert e.code == 400, (bad, e.code)
+            body = json.loads(e.read())
+            assert body["error"] == "ValueError", body
+        else:
+            raise AssertionError(
+                f"bad sampling payload {bad} was not rejected")
+    print("sampled generate check: fixed-seed replay bit-identical, "
+          "5 bad sampling payloads rejected 400 OK")
 
     # ---- Prometheus exposition: scrape + round-trip the parser; the
     # per-model/version/bucket labels must survive.  A class-tagged

@@ -73,13 +73,31 @@ grep -q "LOADTEST_SELFCHECK_OK" <<<"$lt" || {
 # throughput >= 1.5x naive batch-of-requests scan decode on a
 # heavy-tailed mixed-length workload, per-slot streams bit-exact vs
 # the scan path, exactly one compile per (bucket, capacity) plan, and
-# a sanitize-clean warmed decode loop.
-dc=$(timeout -k 10 600 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+# a sanitize-clean warmed decode loop.  Decode engine v2 adds three
+# gated legs to the same run: per-slot sampling (overhead bound vs
+# greedy + bit-identical fixed-seed replay), the prefix-KV pool
+# (>= 1.5x useful tokens/s on a shared-prefix mix, vacuousness-checked
+# both directions), and speculative decoding (beats the plain engine
+# on a greedy heavy-tailed mix, acceptance rate reported).
+dc=$(timeout -k 10 900 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
     XLA_FLAGS="--xla_force_host_platform_device_count=2" \
     python bench.py decode --quick --selfcheck)
 printf '%s\n' "$dc"
 grep -Eq "DECODE_TOKENS_GATE ratio=[0-9.]+x .* PASS" <<<"$dc" || {
     echo "smoke FAIL: decode tokens/s gate missing or failed" >&2
+    exit 1
+}
+grep -Eq "DECODE_SAMPLING_GATE ratio=[0-9.]+x .*replay=ok .*PASS" <<<"$dc" || {
+    echo "smoke FAIL: sampled-decode overhead/replay gate missing or" \
+         "failed" >&2
+    exit 1
+}
+grep -Eq "DECODE_PREFIX_GATE ratio=[0-9.]+x .*PASS" <<<"$dc" || {
+    echo "smoke FAIL: prefix-KV pool gate missing or failed" >&2
+    exit 1
+}
+grep -Eq "DECODE_SPEC_GATE ratio=[0-9.]+x .*acceptance=[0-9.]+ .*PASS" <<<"$dc" || {
+    echo "smoke FAIL: speculative decode gate missing or failed" >&2
     exit 1
 }
 grep -q "DECODE_SELFCHECK_OK" <<<"$dc" || {

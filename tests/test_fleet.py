@@ -374,3 +374,62 @@ def test_least_outstanding_spreads_and_ping(make_fleet):
                      (("model", "m"), ("rank", str(rk)),
                       ("version", "1")))) for rk in (0, 1)]
     assert all(c and c >= 3 for c in counts), counts
+
+
+# ------------------------------------- cross-process determinism (v2)
+def test_cross_process_generate_determinism(tmp_path):
+    """The decode engine v2 determinism contract re-gated across the
+    wire: the same (prompt, seed, sampling params) through a REAL
+    fleet worker process (jax, decode engine, framed protocol) and
+    through a single-process registry built from the SAME artifact
+    spec yields bit-identical tokens — greedy and sampled.  The
+    engine's fold_in RNG has no process-dependent input, and the
+    sampling envelope crosses the wire as plain json scalars, so this
+    is the whole stack's replayability in one assertion."""
+    from analytics_zoo_tpu.serving import ModelRegistry
+    from analytics_zoo_tpu.serving.fleet import builders
+
+    lm_args = {"vocab_size": 32, "seq_len": 48, "n_layers": 1,
+               "d_model": 16, "n_heads": 2, "capacity": 2,
+               "prompt_buckets": [8, 16], "prefix_pool": 2}
+    # 10 tokens: pool-ELIGIBLE (8-token prefix + tail), so the pooled
+    # admission path itself is what replays across processes
+    prompt = [[3, 1, 4, 1, 5, 9, 2, 6, 5, 3]]
+    cases = [
+        dict(max_new_tokens=6),
+        dict(max_new_tokens=6, temperature=0.9, top_k=8, top_p=0.9,
+             seed=77),
+        dict(max_new_tokens=5, temperature=1.3, seed=12345),
+    ]
+
+    # in-process reference: the builder's own deploy kwargs, exactly
+    # what the worker's activate runs from the artifact spec
+    reg = ModelRegistry()
+    try:
+        reg.deploy("lm", **builders.lm(lm_args, None))
+        ref = [[np.asarray(t).tolist() for t in
+                reg.generate("lm", np.asarray(prompt, np.int32), **c)]
+               for c in cases]
+    finally:
+        reg.shutdown()
+
+    r = FleetRouter(str(tmp_path / "share"), n_workers=1, fake=False,
+                    env={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+                    max_restarts=1)
+    try:
+        r.start(timeout=120)
+        rep = r.deploy("lm", None,
+                       "analytics_zoo_tpu.serving.fleet.builders:lm",
+                       builder_args=lm_args)
+        assert all("error" not in a for a in rep["activations"]), rep
+        for c, expect in zip(cases, ref):
+            out, info = r.generate_ex(
+                "lm", np.asarray(prompt, np.int32), **c)
+            got = [np.asarray(t).tolist() for t in out]
+            assert got == expect, (c, got, expect)
+            # replay across the wire too
+            out2, _ = r.generate_ex(
+                "lm", np.asarray(prompt, np.int32), **c)
+            assert [np.asarray(t).tolist() for t in out2] == got
+    finally:
+        r.close()

@@ -80,6 +80,68 @@ def test_sampling_modes():
     np.testing.assert_array_equal(gk, gg)
 
 
+def test_top_p_modes():
+    """Nucleus sampling through the compiled-scan path: deterministic
+    at a fixed seed, and a vanishing nucleus collapses to greedy (the
+    top token always survives the truncation)."""
+    m = _trained_lm()
+    prompt = np.random.default_rng(4).integers(0, VOCAB, (2, 8))
+    g1 = m.generate(prompt, max_new_tokens=4, temperature=0.9,
+                    top_p=0.9, seed=3)
+    g2 = m.generate(prompt, max_new_tokens=4, temperature=0.9,
+                    top_p=0.9, seed=3)
+    np.testing.assert_array_equal(g1, g2)
+    tiny = m.generate(prompt, max_new_tokens=4, temperature=0.9,
+                      top_p=1e-9, seed=3)
+    gg = m.generate(prompt, max_new_tokens=4, temperature=0.0)
+    np.testing.assert_array_equal(tiny, gg)
+    # composes with top_k, and beam search still rejects sampling knobs
+    gc = m.generate(prompt, max_new_tokens=4, temperature=0.8, top_k=9,
+                    top_p=0.8, seed=7)
+    assert gc.shape == (2, 12)
+    with pytest.raises(ValueError, match="deterministic"):
+        m.generate(prompt, max_new_tokens=2, num_beams=2, top_p=0.9)
+
+
+def test_sample_temperature_zero_is_argmax_property():
+    """The pinned property: ``_sample(temperature=0)`` IS argmax —
+    on the static (python-scalar) path the scan decoder compiles, AND
+    on the traced per-slot path the decode engine's step plan selects
+    through — over randomized logits scales/shapes, so scan-decode and
+    step-decode share one greedy-consistent sampling implementation."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.models.generation import _sample
+
+    dyn = jax.jit(lambda lg, key, t, k, p: _sample(lg, key, t, k, p))
+    stat_sampled = jax.jit(
+        lambda lg, key: _sample(lg, key, 0.8, 7, 0.9))
+    rng = np.random.default_rng(11)
+    for trial in range(25):
+        scale = float(rng.uniform(0.1, 20.0))
+        logits = jnp.asarray(
+            rng.normal(size=(5, 33)).astype(np.float32) * scale)
+        key = jax.random.PRNGKey(trial)
+        greedy = np.argmax(np.asarray(logits), axis=-1)
+        # static greedy: the pre-sampling plan, literally an argmax
+        np.testing.assert_array_equal(
+            np.asarray(_sample(logits, key, 0.0, None, None)), greedy)
+        # traced temperature == 0 with sampling knobs riding along
+        # (top_k = 0 / top_p = 1 are the engine's disabled encodings)
+        np.testing.assert_array_equal(
+            np.asarray(dyn(logits, key, jnp.float32(0.0),
+                           jnp.int32(0), jnp.float32(1.0))), greedy)
+        # traced-vs-static equivalence of the ENABLED path: the
+        # engine's dynamic top-k/top-p masks truncate identically to
+        # the scan path's baked-in constants, so one request samples
+        # the same token through either decoder
+        np.testing.assert_array_equal(
+            np.asarray(dyn(logits, key, jnp.float32(0.8),
+                           jnp.int32(7), jnp.float32(0.9))),
+            np.asarray(stat_sampled(logits, key)))
+
+
 def test_generate_moe_variant():
     """The Switch-MoE sublayer decodes through the same cache path.
     capacity_factor = n_experts makes BOTH paths drop-free (decode is

@@ -224,6 +224,8 @@ def test_engine_config_validation(lm):
     params, hyper = lm.trainer.state.params, lm.hyper
     with pytest.raises(ValueError, match="capacity"):
         DecodeEngine(params, hyper, capacity=0)
+    with pytest.raises(ValueError, match="prefix_pool"):
+        DecodeEngine(params, hyper, capacity=1, prefix_pool=-1)
     with pytest.raises(ValueError, match="positional table"):
         DecodeEngine(params, hyper, capacity=1, max_len=SEQ + 1)
     with pytest.raises(ValueError, match="room to decode"):
@@ -391,6 +393,286 @@ def test_failed_reload_leaves_handle_on_old_version(lm):
         assert np.asarray(out).shape[-1] == VOCAB
     finally:
         im.close()
+
+
+# ------------------------------------------------- decode engine v2
+def test_sampled_streams_replay_and_occupancy_invariance(lm, engine):
+    """The sampling contract: a (prompt, sampling params, seed) tuple
+    replays bit-identically, and the stream is invariant to WHO ELSE
+    is decoding — the per-slot fold_in key depends only on (seed,
+    absolute token index), and a slot's logits only on its own
+    cache."""
+    rng = np.random.default_rng(41)
+    prompts = [rng.integers(0, VOCAB, int(n)) for n in (4, 9, 6)]
+    kw = dict(temperature=0.8, top_k=16, top_p=0.95)
+    a = engine.generate(prompts, [8, 5, 7], seed=[7, 8, 9],
+                        timeout=120, **kw)
+    b = engine.generate(prompts, [8, 5, 7], seed=[7, 8, 9],
+                        timeout=120, **kw)
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+    # the same request ALONE (occupancy 1, different slot schedule)
+    alone = engine.generate([prompts[1]], [5], seed=8, timeout=120,
+                            **kw)[0]
+    assert np.array_equal(alone, a[1])
+    # and a different seed diverges (astronomically unlikely to
+    # collide on every token at temperature 0.8)
+    c = engine.generate([prompts[1]], [5], seed=1234, timeout=120,
+                        **kw)[0]
+    assert not np.array_equal(c, a[1])
+    assert engine.stats()["sampled_tokens"] >= 27
+
+
+def test_greedy_requests_share_the_sampling_plan_bit_exact(lm, engine):
+    """temperature=0 THROUGH the sampling-capable step plan still
+    argmaxes — greedy and sampled requests decode side by side in one
+    dispatch and the greedy stream stays pinned to the scan path."""
+    rng = np.random.default_rng(43)
+    gp, sp = rng.integers(0, VOCAB, 6), rng.integers(0, VOCAB, 9)
+    ref = scan_ref(lm, gp, 8)
+    s_greedy = engine.submit(gp, 8)
+    s_sampled = engine.submit(sp, 8, temperature=1.1, seed=5)
+    out_g = s_greedy.result(timeout=120)
+    s_sampled.result(timeout=120)
+    assert np.array_equal(out_g, ref)
+
+
+def test_sampling_validation(engine):
+    with pytest.raises(ValueError, match="temperature"):
+        engine.submit([1, 2], 4, temperature=-0.5)
+    with pytest.raises(ValueError, match="temperature"):
+        engine.submit([1, 2], 4, temperature=float("nan"))
+    with pytest.raises(ValueError, match="top_k"):
+        engine.submit([1, 2], 4, temperature=0.5, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        engine.submit([1, 2], 4, temperature=0.5, top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        engine.submit([1, 2], 4, temperature=0.5, top_p=1.5)
+    with pytest.raises(ValueError, match="seed"):
+        engine.submit([1, 2], 4, seed=-1)
+    with pytest.raises(ValueError, match="seed"):
+        engine.generate([[1, 2]], [4], seed=[2 ** 40])
+
+
+@pytest.fixture(scope="module")
+def shared_prefix_requests(lm):
+    """A shared-system-prompt mix: every prompt opens with the same
+    8-token prefix (= the small bucket, so the pool splits there) and
+    carries its own tail."""
+    rng = np.random.default_rng(47)
+    sys_prompt = rng.integers(0, VOCAB, 8)
+    prompts = [np.concatenate([sys_prompt,
+                               rng.integers(0, VOCAB, int(u))])
+               for u in (3, 5, 2, 0, 7)]
+    return sys_prompt, prompts
+
+
+def _pool_engine(lm, size):
+    eng = DecodeEngine(lm.trainer.state.params, lm.hyper, capacity=2,
+                       max_len=SEQ, prompt_buckets=(8, BUCKET),
+                       prefix_pool=size)
+    eng.warmup()
+    return eng
+
+
+def test_prefix_pool_hits_and_streams_match_pool_off(
+        lm, shared_prefix_requests):
+    """Pool hits serve the SAME streams as a pool-less engine (one
+    prefix prefill for the whole mix instead of five), and a repeat
+    pass is all hits."""
+    _, prompts = shared_prefix_requests
+    max_news = [6] * len(prompts)
+    pooled = _pool_engine(lm, size=4)
+    plain = DecodeEngine(lm.trainer.state.params, lm.hyper, capacity=2,
+                         max_len=SEQ, prompt_buckets=(8, BUCKET))
+    plain.warmup()
+    try:
+        o_pool = pooled.generate(prompts, max_news, timeout=120)
+        o_plain = plain.generate(prompts, max_news, timeout=120)
+        for a, b in zip(o_pool, o_plain):
+            assert np.array_equal(a, b), (a, b)
+        st = pooled.stats()
+        assert st["prefix_misses"] == 1  # one compute of the prefix
+        assert st["prefix_hits"] == len(prompts) - 1
+        o2 = pooled.generate(prompts, max_news, timeout=120)
+        for a, b in zip(o2, o_pool):
+            assert np.array_equal(a, b)
+        assert pooled.stats()["prefix_misses"] == 1  # still one
+    finally:
+        pooled.close()
+        plain.close()
+
+
+def test_prefix_pool_eviction_recomputes_never_wrong(lm):
+    """Memory pressure: a 1-entry pool alternating two prefixes
+    evicts every admission — each recomputes its OWN prefix (streams
+    stay bit-identical to the first pass), never serves the other's
+    block."""
+    rng = np.random.default_rng(53)
+    pfx_a, pfx_b = (rng.integers(0, VOCAB, 8) for _ in range(2))
+    pa = np.concatenate([pfx_a, rng.integers(0, VOCAB, 4)])
+    pb = np.concatenate([pfx_b, rng.integers(0, VOCAB, 4)])
+    eng = _pool_engine(lm, size=1)
+    try:
+        ref_a = eng.generate([pa], [6], timeout=120)[0]
+        ref_b = eng.generate([pb], [6], timeout=120)[0]
+        for _ in range(2):  # thrash: a evicts b evicts a ...
+            assert np.array_equal(
+                eng.generate([pa], [6], timeout=120)[0], ref_a)
+            assert np.array_equal(
+                eng.generate([pb], [6], timeout=120)[0], ref_b)
+        st = eng.stats()
+        assert st["prefix_evictions"] >= 4, st
+        assert st["prefix_hits"] == 0  # every admission recomputed
+        assert st["prefix_pool_entries"] == 1
+    finally:
+        eng.close()
+
+
+def test_prefix_pool_zero_further_compiles(lm, zoolint_sanitize,
+                                           shared_prefix_requests):
+    """A warmed pooled engine serves eligible (split) AND ineligible
+    (short, monolithic) prompts — hits, misses, evictions — with ZERO
+    further compiles: every (prefix, bucket) pair plan was warmed."""
+    _, prompts = shared_prefix_requests
+    eng = _pool_engine(lm, size=1)
+    rng = np.random.default_rng(59)
+    try:
+        with zoolint_sanitize(max_compiles=0):
+            eng.generate(prompts, [4] * len(prompts), timeout=120)
+            eng.generate([rng.integers(0, VOCAB, 3)], [4],
+                         timeout=120)  # < smallest bucket: monolithic
+            eng.generate([rng.integers(0, VOCAB, 16)], [4],
+                         timeout=120)  # exact-bucket prefix, no tail
+    finally:
+        eng.close()
+
+
+def _skeleton_draft(lm):
+    """The 0-layer draft: the target's embedding/unembedding skeleton
+    (token+position embed -> final LN -> lm_head) — the cheapest
+    possible proposer, supported by the generic decode math."""
+    params = lm.trainer.state.params
+    dparams = {k: params[k] for k in ("tok_embed", "pos_embed",
+                                      "ln_final", "lm_head")}
+    return dparams, dict(lm.hyper, n_layers=0, moe_every=0)
+
+
+def _spec_engine(lm, dparams, dhyper, k=4, params=None):
+    eng = DecodeEngine(params if params is not None
+                       else lm.trainer.state.params,
+                       lm.hyper, capacity=3, max_len=SEQ,
+                       prompt_buckets=(BUCKET,), draft_params=dparams,
+                       draft_hyper=dhyper, spec_tokens=k)
+    eng.warmup()
+    return eng
+
+
+def test_spec_forced_full_rejection_is_bit_exact(lm):
+    """The fallback pin: a draft that ALWAYS proposes token 0 against
+    a target that NEVER emits it (lm_head bias -1e9 on token 0)
+    forces full rejection on every window — acceptance 0, one exact
+    token per window, streams bit-identical to the same target
+    decoding non-speculatively.  By construction, not by luck: the
+    exact token is the same traced step body the plain plan runs."""
+    import jax.numpy as jnp
+
+    params = lm.trainer.state.params
+    tweaked = dict(params)
+    head = dict(params["lm_head"])
+    head["b"] = jnp.asarray(
+        np.asarray(head["b"]).copy()
+        + np.eye(1, np.asarray(head["b"]).shape[0], 0)[0] * -1e9)
+    tweaked["lm_head"] = head
+    dparams, dhyper = _skeleton_draft(lm)
+    dhead = dict(head)
+    dhead["b"] = jnp.asarray(np.asarray(params["lm_head"]["b"]).copy()
+                             + np.eye(1, np.asarray(head["b"]).shape[0],
+                                      0)[0] * 1e9)
+    dparams = dict(dparams)
+    dparams["lm_head"] = dhead
+
+    rng = np.random.default_rng(61)
+    prompts = [rng.integers(1, VOCAB, int(n)) for n in (4, 9, 6)]
+    max_news = [9, 4, 7]
+    spec = _spec_engine(lm, dparams, dhyper, params=tweaked)
+    plain = DecodeEngine(tweaked, lm.hyper, capacity=3, max_len=SEQ,
+                         prompt_buckets=(BUCKET,))
+    plain.warmup()
+    try:
+        o_spec = spec.generate(prompts, max_news, timeout=120)
+        o_plain = plain.generate(prompts, max_news, timeout=120)
+        for a, b in zip(o_spec, o_plain):
+            assert np.array_equal(a, b), (a, b)
+        st = spec.stats()
+        assert st["spec_proposed"] > 0
+        assert st["spec_accepted"] == 0  # full rejection, every window
+        assert st["spec_acceptance"] == 0.0
+        assert not any(0 in np.asarray(o) for o in o_spec)
+    finally:
+        spec.close()
+        plain.close()
+
+
+def test_spec_streams_match_non_spec_and_accept(lm):
+    """The general case: a residual-dominated target (block outputs
+    down-scaled, the agreement regime a distilled draft provides)
+    against its 0-layer skeleton draft — real acceptance, streams
+    still identical to the non-speculative engine, greedy AND
+    sampled."""
+    import jax
+
+    params = lm.trainer.state.params
+    scaled = jax.tree_util.tree_map(lambda a: a, dict(params))
+    for name in list(scaled):
+        if name.startswith(("attn_", "mlp_", "ln_attn", "ln_mlp",
+                            "moe_")):
+            scaled[name] = jax.tree_util.tree_map(
+                lambda a: a * 0.05, scaled[name])
+    dparams, dhyper = _skeleton_draft(lm)
+    rng = np.random.default_rng(67)
+    prompts = [rng.integers(0, VOCAB, int(n)) for n in (4, 9, 6, 12)]
+    max_news = [9, 4, 12, 6]
+    spec = _spec_engine(lm, dparams, dhyper, params=scaled)
+    plain = DecodeEngine(scaled, lm.hyper, capacity=3, max_len=SEQ,
+                         prompt_buckets=(BUCKET,))
+    plain.warmup()
+    try:
+        o_spec = spec.generate(prompts, max_news, timeout=120)
+        o_plain = plain.generate(prompts, max_news, timeout=120)
+        for a, b in zip(o_spec, o_plain):
+            assert np.array_equal(a, b), (a, b)
+        st = spec.stats()
+        assert st["spec_accepted"] > 0, st
+        # sampled verification: the window positions draw from the
+        # same fold_in keys the plain engine uses -> identical streams
+        s_spec = spec.generate(prompts, max_news, temperature=0.7,
+                               top_k=24, seed=[1, 2, 3, 4],
+                               timeout=120)
+        s_plain = plain.generate(prompts, max_news, temperature=0.7,
+                                 top_k=24, seed=[1, 2, 3, 4],
+                                 timeout=120)
+        for a, b in zip(s_spec, s_plain):
+            assert np.array_equal(a, b), (a, b)
+    finally:
+        spec.close()
+        plain.close()
+
+
+def test_spec_config_validation(lm):
+    params, hyper = lm.trainer.state.params, lm.hyper
+    dparams, dhyper = _skeleton_draft(lm)
+    with pytest.raises(ValueError, match="BOTH draft_params"):
+        DecodeEngine(params, hyper, draft_params=dparams)
+    with pytest.raises(ValueError, match="spec_tokens"):
+        DecodeEngine(params, hyper, draft_params=dparams,
+                     draft_hyper=dhyper, spec_tokens=1)
+    with pytest.raises(ValueError, match="vocabulary"):
+        DecodeEngine(params, hyper, draft_params=dparams,
+                     draft_hyper=dict(dhyper, vocab_size=7))
+    with pytest.raises(ValueError, match="mutually"):
+        DecodeEngine(params, hyper, draft_params=dparams,
+                     draft_hyper=dhyper, prefix_pool=2)
 
 
 def test_registry_generate_and_decode_families(lm):
