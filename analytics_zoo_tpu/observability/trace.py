@@ -43,12 +43,17 @@ from typing import Any, Dict, List, Optional
 
 from .metrics import Family
 
-#: the canonical request phase order (docs/observability.md).  The
-#: first six are the one-shot predict chain; the last three belong to
-#: the continuous-batching generate path (decode_wait covers the
-#: engine queue, prefill the bucketed prompt pass + slot insert,
-#: decode_step the whole shared-step participation until eviction).
-PHASES = ("admission_queue", "coalesce_wait", "pad", "device_put",
+#: the canonical request phase order (docs/observability.md).  After
+#: admission come the weight pager's cold-start phases (absent on the
+#: resident hot path): pager_wait parks behind an in-flight fault,
+#: weights_h2d is the one device_put of the host weights, and
+#: exec_rehydrate the execstore warmup of the bucket ladder.  Then the
+#: one-shot predict chain; the last three belong to the
+#: continuous-batching generate path (decode_wait covers the engine
+#: queue, prefill the bucketed prompt pass + slot insert, decode_step
+#: the whole shared-step participation until eviction).
+PHASES = ("admission_queue", "pager_wait", "weights_h2d",
+          "exec_rehydrate", "coalesce_wait", "pad", "device_put",
           "execute", "depad", "decode_wait", "prefill", "decode_step")
 
 #: the training-step phase order (train/stepprof.py; same gap-free
@@ -336,6 +341,25 @@ class Tracer:
                 if s.trace_id == trace_id:
                     return s.to_dict()
         return None
+
+    def retire(self, **labels: Any) -> int:
+        """Drop finished spans whose labels match ALL of ``labels``
+        (e.g. ``retire(model="ncf")`` when that model is undeployed):
+        a long-lived process cycling many models must not keep dead
+        models' spans pinned in the ring until traffic happens to wash
+        them out.  Phase aggregates are label-free totals and stay.
+        Returns the number of spans dropped."""
+        if not labels:
+            return 0
+        with self._lock:
+            kept = [s for s in self._ring
+                    if any(s.labels.get(k) != v
+                           for k, v in labels.items())]
+            dropped = len(self._ring) - len(kept)
+            if dropped:
+                self._ring.clear()
+                self._ring.extend(kept)
+        return dropped
 
     def phase_stats(self) -> Dict[str, Dict[str, float]]:
         """Per-phase duration aggregation over every finished span."""

@@ -325,7 +325,11 @@ class DecodeEngine:
                  eos_id: Optional[int] = None, max_queue: int = 256,
                  step_fuse: int = 4, prefix_pool: int = 0,
                  draft_params=None, draft_hyper: Optional[Dict] = None,
-                 spec_tokens: int = 4, device=None):
+                 spec_tokens: int = 4, device=None,
+                 store_tag: Optional[str] = None):
+        # per-model accounting tag for execstore entries (stat
+        # --by-model); metadata only, never part of the fingerprint
+        self._store_tag = store_tag
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         if (draft_params is None) != (draft_hyper is None):
@@ -649,10 +653,13 @@ class DecodeEngine:
         compiled = lowered.compile()
         if store is not None:
             try:
+                meta = {"kind": "decode-plan", "name": name,
+                        "capacity": self.capacity,
+                        "max_len": self.max_len}
+                if self._store_tag is not None:
+                    meta["model"] = self._store_tag
                 store.put(fp, _execstore().serialize_compiled(compiled),
-                          meta={"kind": "decode-plan", "name": name,
-                                "capacity": self.capacity,
-                                "max_len": self.max_len})
+                          meta=meta)
             except Exception as e:  # noqa: BLE001 — persisting is
                 # best-effort: serving proceeds on the fresh compile
                 _slog.error("decode_plan_store_failed", plan=name,

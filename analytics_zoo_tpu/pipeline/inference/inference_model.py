@@ -76,7 +76,8 @@ class InferenceModel:
                  decode_eos_id: Optional[int] = None,
                  decode_prefix_pool: int = 0,
                  decode_draft=None,
-                 decode_spec_tokens: int = 4):
+                 decode_spec_tokens: int = 4,
+                 store_tag: Optional[str] = None):
         """``supported_concurrent_num`` bounds concurrent device work
         (reference semantics; PER REPLICA when replicated — the
         effective bound scales with the replica count).  The serving
@@ -126,6 +127,10 @@ class InferenceModel:
           a ``(params, hyper)`` pair) enables speculative decoding of
           up to ``decode_spec_tokens`` tokens per dispatch.
         """
+        # per-model accounting tag for the persistent executable store
+        # (``stat --by-model``): metadata on every entry this handle
+        # persists, never part of a fingerprint
+        self.store_tag = store_tag
         self.concurrent_num = int(supported_concurrent_num)
         self._semaphore = threading.Semaphore(self.concurrent_num)
         self._sem_capacity = self.concurrent_num
@@ -241,7 +246,8 @@ class InferenceModel:
             eos_id=self._decode_eos_id,
             prefix_pool=self._decode_prefix_pool,
             draft_params=draft_params, draft_hyper=draft_hyper,
-            spec_tokens=self._decode_spec_tokens)
+            spec_tokens=self._decode_spec_tokens,
+            store_tag=self.store_tag)
         engine.warmup()
         return engine
 
@@ -270,6 +276,17 @@ class InferenceModel:
             return out
 
         return self.load_jax(run, params)
+
+    def load_graph(self, graph, params, state=None):
+        """Serve a prebuilt pure graph (``graph.apply(params, state,
+        x, training=False)``) with an explicit param/state tree — the
+        weight pager's keras-side fault-in path: a cold deployment
+        keeps the graph plus HOST numpy weights, and this call places
+        them exactly once (the replica set's ``device_put``; the
+        placed-tree discipline of :meth:`load_jax`)."""
+        self._quantize_flag = False
+        self._attach(graph, params, state)
+        return self
 
     def load_jax(self, fn, params):
         """Serve a raw jax function fn(params, x) (the TFNet-equivalent
@@ -362,7 +379,8 @@ class InferenceModel:
             if (n_rep > 1 or store_on) and replica_fn is not None:
                 replica_set = ReplicaSet(
                     replica_fn, replica_params,
-                    devices=jax.local_devices()[:n_rep])
+                    devices=jax.local_devices()[:n_rep],
+                    tag=self.store_tag)
             cache = BucketedExecutableCache(
                 predict_fn, max_batch=self.max_batch_size,
                 buckets=self._buckets, growth=self._bucket_growth,

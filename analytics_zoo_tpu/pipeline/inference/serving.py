@@ -220,8 +220,11 @@ class ReplicaSet:
     def __init__(self, fn: Callable, params, devices=None,
                  probe_backoff_s: float = 0.5,
                  probe_backoff_max_s: float = 30.0,
-                 store="auto"):
+                 store="auto", tag: Optional[str] = None):
         self._fn = fn
+        # per-model accounting tag for the executable store (stat
+        # --by-model): rides every entry's header meta, never the key
+        self._tag = tag
         # one jit wrapper for the whole set: every bucket's lowering
         # comes from it (a per-compile jax.jit would re-trace per call)
         self._jit = jax.jit(fn)
@@ -419,9 +422,11 @@ class ReplicaSet:
                     # write-behind: the device-0 serialization the
                     # multi-replica path produces anyway, plus the
                     # metadata the raw dispatch path needs back
-                    store.put(fp, ser, meta={
-                        "kind": "replica-forward", "kept": kept_t,
-                        "n_in": n_in})
+                    meta = {"kind": "replica-forward", "kept": kept_t,
+                            "n_in": n_in}
+                    if self._tag is not None:
+                        meta["model"] = self._tag
+                    store.put(fp, ser, meta=meta)
             exes = [exe0]
             # place everywhere: one serialization (from the compile or
             # from the store entry), loaded per device with only the

@@ -1,24 +1,27 @@
 """Serving control plane: multi-model registry, zero-downtime hot-swap,
 admission control & priority-aware load shedding, canary traffic
-splitting, replica autoscaling, and a metrics snapshot API — the
-lifecycle layer over the ``pipeline.inference`` data plane (bucketed
-executables + request coalescing + replica sets).  See docs/serving.md
-§"Control plane" and §"Elasticity"."""
+splitting, replica autoscaling, weight/executable paging for serving
+density, and a metrics snapshot API — the lifecycle layer over the
+``pipeline.inference`` data plane (bucketed executables + request
+coalescing + replica sets).  See docs/serving.md §"Control plane",
+§"Elasticity" and §"Serving density & weight paging"."""
 
 from . import execstore, fleet
 from .admission import AdmissionController
 from .autoscale import Autoscaler, autoscaler_for
-from .errors import (DeadlineExceeded, DeployError, ModelNotFound,
-                     Overloaded, ServingError, error_response)
+from .errors import (ColdStartTimeout, DeadlineExceeded, DeployError,
+                     ModelNotFound, Overloaded, ServingError,
+                     error_response)
 from .execstore import ExecStore
 from .metrics import (Counters, LatencyWindow, registry_collector,
                       registry_families)
+from .pager import ModelPager, PageRecipe
 from .registry import ModelRegistry
 
 __all__ = [
-    "AdmissionController", "Autoscaler", "Counters", "DeadlineExceeded",
-    "DeployError", "ExecStore", "LatencyWindow", "ModelNotFound",
-    "ModelRegistry", "Overloaded", "ServingError", "autoscaler_for",
-    "error_response", "execstore", "fleet", "registry_collector",
-    "registry_families",
+    "AdmissionController", "Autoscaler", "ColdStartTimeout", "Counters",
+    "DeadlineExceeded", "DeployError", "ExecStore", "LatencyWindow",
+    "ModelNotFound", "ModelPager", "ModelRegistry", "Overloaded",
+    "PageRecipe", "ServingError", "autoscaler_for", "error_response",
+    "execstore", "fleet", "registry_collector", "registry_families",
 ]

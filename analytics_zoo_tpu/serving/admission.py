@@ -78,6 +78,25 @@ _OVERFLOW_CLASS = "__overflow__"
 _WAITING, _GRANTED, _EVICTED = 0, 1, 2
 
 
+class AdmissionGrant:
+    """The handle ``admit()`` yields for the ``with`` body.
+
+    ``exclude_service_s`` subtracts one-off, non-recurring seconds
+    from what this request contributes to the service-time EWMA.  The
+    weight pager uses it for cold-start fault-ins: the EWMA predicts
+    STEADY-STATE service for deadline shedding, and one 100 ms weight
+    fault recorded as service time would predictively shed every
+    deadline request behind it against a cost they will never pay."""
+
+    __slots__ = ("excluded_s",)
+
+    def __init__(self):
+        self.excluded_s = 0.0
+
+    def exclude_service_s(self, seconds: float) -> None:
+        self.excluded_s += max(0.0, float(seconds))
+
+
 class _Ticket:
     """One queued admission request."""
 
@@ -205,7 +224,10 @@ class AdmissionController:
         whichever phase the data plane starts next — so queue wait and
         slot wait are attributed, gap-free, even when admission is
         instant.  ``priority_class`` tags the request for shedding
-        order and fair-share scheduling (default class when None)."""
+        order and fair-share scheduling (default class when None).
+        Yields an :class:`AdmissionGrant` (callers that ignore it are
+        unchanged; the weight pager excludes cold-start fault seconds
+        from the service EWMA through it)."""
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         if span is not None:
@@ -214,12 +236,15 @@ class AdmissionController:
         deadline = None if deadline_ms is None else t0 + deadline_ms / 1e3
         self._acquire(t0, deadline, deadline_ms, priority_class)
         t_service = time.perf_counter()
+        grant = AdmissionGrant()
         try:
-            yield
+            yield grant
         except BaseException:
-            self._release(t_service, error=True)
+            self._release(t_service, error=True,
+                          excluded_s=grant.excluded_s)
             raise
-        self._release(t_service, error=False)
+        self._release(t_service, error=False,
+                      excluded_s=grant.excluded_s)
 
     def _predicted_wait_s(self, cls: "_PriorityClass") -> Optional[float]:
         """Predicted time to COMPLETE a ``cls`` request admitted now:
@@ -415,8 +440,11 @@ class AdmissionController:
             if granted:
                 self._cond.notify_all()
 
-    def _release(self, t_service: float, error: bool):
-        dt = time.perf_counter() - t_service
+    def _release(self, t_service: float, error: bool,
+                 excluded_s: float = 0.0):
+        # excluded seconds (a pager fault-in) are one-off setup, not
+        # service: the EWMA must keep predicting the steady state
+        dt = max(0.0, time.perf_counter() - t_service - excluded_s)
         with self._cond:
             self._running -= 1
             self.counters.inc("errors" if error else "completed")

@@ -64,6 +64,22 @@ class DeadlineExceeded(ServingError):
     http_status = 504
 
 
+class ColdStartTimeout(ServingError):
+    """A request to a paged-out (cold) model queued for its fault-in
+    but the deadline lapsed before the weights/executables were
+    resident again.
+
+    Cold-start handling is admission-integrated: a faulting request
+    QUEUES under its own deadline (it is legitimate, promised work —
+    never shed for merely being cold) and only past that deadline does
+    it fail, with this structured 503 instead of a generic late
+    timeout.  The fault-in itself keeps running — the model still
+    becomes resident for the next caller, so a retry after the
+    suggested backoff normally lands hot."""
+
+    http_status = 503
+
+
 class DeployError(ServingError):
     """A deploy failed before the swap (build or warmup error).  The
     previously active version is untouched and keeps serving — this is

@@ -403,12 +403,26 @@ class ExecStore:
             try:
                 with open(self._path(fp), "rb") as f:
                     head = f.readline(1 << 16)
-                kind = json.loads(head).get("meta", {}).get("kind", "?")
+                meta = json.loads(head).get("meta", {})
+                kind = meta.get("kind", "?")
+                model = meta.get("model", "-")
             except Exception:  # noqa: BLE001 — stat must never crash
-                kind = "unreadable"
+                kind, model = "unreadable", "-"
             out.append({"fingerprint": fp, "bytes": size,
-                        "mtime": mtime, "kind": kind})
+                        "mtime": mtime, "kind": kind, "model": model})
         return out
+
+    def by_model(self) -> Dict[str, Dict[str, int]]:
+        """Entries/bytes aggregated by the writer's ``model`` meta tag
+        (the registry name the deploy served; ``-`` for untagged
+        entries) — what a density fleet's operator reads to see which
+        models the shared store keeps on disk."""
+        agg: Dict[str, Dict[str, int]] = {}
+        for e in self.entries():
+            row = agg.setdefault(e["model"], {"entries": 0, "bytes": 0})
+            row["entries"] += 1
+            row["bytes"] += e["bytes"]
+        return agg
 
 
 _FAMILY_HELP = {
@@ -481,8 +495,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--root", default=None,
                         help=f"store directory (default: ${ENV_DIR})")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    sub.add_parser("stat", parents=[common],
-                   help="print store contents and counters")
+    p_stat = sub.add_parser("stat", parents=[common],
+                            help="print store contents and counters")
+    p_stat.add_argument("--by-model", action="store_true",
+                        help="aggregate entries/bytes per model tag "
+                             "(the registry name each deploy wrote)")
     p_gc = sub.add_parser("gc", parents=[common],
                           help="LRU-evict down to a byte budget")
     p_gc.add_argument("--budget", type=int, default=None,
@@ -498,10 +515,19 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{s['bytes']:,} bytes"
               + (f" (budget {s['byte_budget']:,})"
                  if s["byte_budget"] else ""))
+        if getattr(args, "by_model", False):
+            # largest first: the density question is "what is eating
+            # the store", answered top-down
+            agg = sorted(store.by_model().items(),
+                         key=lambda kv: -kv[1]["bytes"])
+            for model, row in agg:
+                print(f"  {model:<24} {row['entries']:>5} entries  "
+                      f"{row['bytes']:>12,} B")
+            return 0
         for e in store.entries():
             age = time.time() - e["mtime"]
             print(f"  {e['fingerprint'][:16]}  {e['bytes']:>10,} B  "
-                  f"{age:>8.0f}s old  {e['kind']}")
+                  f"{age:>8.0f}s old  {e['kind']}  {e['model']}")
         return 0
     budget = args.budget
     if budget is None:

@@ -162,6 +162,11 @@ _ERROR_CLASSES = {
     "DeadlineExceeded": _errors.DeadlineExceeded,
     "DeployError": _errors.DeployError,
     "ServingError": _errors.ServingError,
+    # a worker's cold-start SLO miss must reach the client as the
+    # concrete 503 — and, being a structured serving error, it is
+    # NEVER retried on a sibling (the router's rule), so one slow
+    # fault cannot make every worker fault the same model
+    "ColdStartTimeout": _errors.ColdStartTimeout,
 }
 
 

@@ -534,6 +534,39 @@ class FleetRouter:
             results.append(entry)
         return {"version": promoted, "activations": results}
 
+    def undeploy(self, model: str) -> Dict[str, Any]:
+        """Fan out an undeploy to every routable worker and RETIRE the
+        model's fleet-level series: the per-(model, version) fan-out
+        gauge and the active-version map are dropped, so a density
+        fleet cycling hundreds of models does not grow the router
+        scrape (or its memory) one dead series per deploy forever.
+        Committed artifacts stay on the share (undeploy retires the
+        SERVING state, not the deploy history); per-worker error
+        discipline matches deploy/promote — a dead worker's
+        replacement simply never replays the retired model."""
+        results = []
+        for h in list(self.handles):
+            if not h.routable:
+                continue
+            entry: Dict[str, Any] = {"rank": h.rank}
+            try:
+                resp = self._call(h, {"op": "undeploy",
+                                      "model": model})
+                entry.update(resp["result"])
+            except (ConnectionError, ServingError) as e:
+                entry["error"] = f"{type(e).__name__}: {e}"
+                _slog.error("fleet_undeploy_failed", rank=h.rank,
+                            model=model, error=entry["error"])
+            results.append(entry)
+        with self._lock:
+            self._active.pop(model, None)
+            self._next_version.pop(model, None)
+            for key in [k for k in self._fanouts if k[0] == model]:
+                self._fanouts.pop(key, None)
+        _slog.info("fleet_undeploy", model=model,
+                   workers=[r["rank"] for r in results])
+        return {"model": model, "activations": results}
+
     def ping(self, rank: int) -> Dict[str, Any]:
         return self._call(self.handles[rank],
                           {"op": "ping"})["result"]

@@ -23,6 +23,13 @@ Supervision contract (the PR 10 machinery, reused):
 * ``ZOO_EXECSTORE_DIR`` — the shared store; a warm activate records
   zero ``backend_compile`` events (reported per activate, which is
   how the fleet drill gates it cross-process);
+* ``ZOO_PAGER_RESIDENT`` — when set (an int), the worker's registry
+  runs a weight pager with that resident budget: each worker pages
+  independently over the SHARED execstore, so a density fleet keeps
+  one on-disk copy of every executable while each worker holds only
+  its own traffic's working set on device.  ``--registry-json
+  '{"pager": {...}}'`` configures the full knob set and wins over
+  the env;
 * the port file is written ATOMICALLY once the socket is listening —
   its presence is the router's readiness signal, and a restarted
   incarnation's fresh port lands the same way.
@@ -93,6 +100,7 @@ class ServingWorker:
         # analyzer sees exactly as in the code's intent
         self._control = {"activate": self._activate,
                          "promote": self._promote,
+                         "undeploy": self._undeploy,
                          "ping": self._ping,
                          "metrics": self._metrics,
                          "shutdown": self._shutdown}
@@ -243,6 +251,18 @@ class ServingWorker:
         return {"result": {"version": self.registry.promote(
             req["model"])}}
 
+    def _undeploy(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """Retire one model: drain + close in the registry, which also
+        detaches it from the pager and drops its spans — the worker's
+        next scrape carries none of its series (the registry snapshot
+        is the collector), so a cycling density fleet's exposition
+        stays bounded by what is DEPLOYED, not by what ever was."""
+        drained = self.registry.undeploy(
+            req["model"],
+            drain_timeout=float(req.get("drain_timeout", 10.0)))
+        return {"result": {"model": req["model"], "drained": drained,
+                           "rank": self.rank}}
+
     def _ping(self, req: Dict[str, Any]) -> Dict[str, Any]:
         return {"result": {"pid": os.getpid(), "rank": self.rank,
                            "incarnation": self.incarnation,
@@ -312,6 +332,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     flightrec.install_from_env()
     reg_kwargs = json.loads(args.registry_json) if args.registry_json \
         else {}
+    pager_env = os.environ.get("ZOO_PAGER_RESIDENT")
+    if pager_env and "pager" not in reg_kwargs:
+        try:
+            reg_kwargs["pager"] = {"max_resident": int(pager_env)}
+        except ValueError:
+            _slog.error("fleet_worker_bad_pager_env", value=pager_env)
     worker = ServingWorker(args.share, registry_kwargs=reg_kwargs,
                            fake=args.fake)
     if not args.fake:

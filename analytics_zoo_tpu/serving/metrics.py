@@ -98,6 +98,14 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
         "zoo_decode_slot_occupancy": [],
         "zoo_decode_slot_capacity": [],
     }
+    # weight pager (serving density): residency per model plus the
+    # fault/eviction outcome counters — exported for every PAGED model
+    # (zeros until the pager acts) so density dashboards pre-wire
+    pager_gauges: Dict[str, List] = {"zoo_model_resident": []}
+    pager_counters: Dict[str, List] = {
+        "zoo_pager_faults_total": [],
+        "zoo_pager_evictions_total": [],
+    }
     # ONE summary family for every (model, version): emitting a Family
     # per version would render duplicate # TYPE blocks for the same
     # name, which real Prometheus parsers reject outright
@@ -171,6 +179,21 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
         if "coalescer_pending" in serving:
             model_gauges["zoo_coalescer_pending"].append(
                 (ml, serving["coalescer_pending"]))
+        pager = m.get("pager")
+        if pager:
+            pager_gauges["zoo_model_resident"].append(
+                (ml, 1 if pager.get("resident") else 0))
+            for outcome, key in (("ok", "fault_ok"),
+                                 ("timeout", "fault_timeout"),
+                                 ("error", "fault_error")):
+                pager_counters["zoo_pager_faults_total"].append(
+                    ({"model": model, "outcome": outcome},
+                     pager.get(key, 0)))
+            for reason, key in (("idle", "evict_idle"),
+                                ("pressure", "evict_pressure")):
+                pager_counters["zoo_pager_evictions_total"].append(
+                    ({"model": model, "reason": reason},
+                     pager.get(key, 0)))
         dec = serving.get("decode")
         if dec:
             for prom_name, key in (
@@ -284,15 +307,25 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
             "decode slots currently holding a live sequence",
         "zoo_decode_slot_capacity":
             "decode slots in the persistent step executable",
+        "zoo_model_resident":
+            "1 when the paged model's weights/executables are on-"
+            "device (0 while cold/faulting/evicting)",
+        "zoo_pager_faults_total":
+            "cold-start fault-ins per paged model by request outcome "
+            "(ok/timeout/error)",
+        "zoo_pager_evictions_total":
+            "pager demotions to cold per model by trigger "
+            "(idle/pressure)",
     }
     out: List[Family] = []
     gauge_groups = (model_gauges, version_gauges, replica_gauges,
-                    class_gauges, decode_gauges,
+                    class_gauges, decode_gauges, pager_gauges,
                     {k: v for k, v in admission.items()
                      if not k.endswith("_total")})
     counter_groups = (model_counters, version_counters,
                       bucket_counters, coalescer_counters,
                       replica_counters, class_counters, decode_counters,
+                      pager_counters,
                       {k: v for k, v in admission.items()
                        if k.endswith("_total")})
     for groups, mtype in ((gauge_groups, "gauge"),

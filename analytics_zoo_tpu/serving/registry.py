@@ -46,8 +46,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..observability import trace as _trace
 from .admission import AdmissionController
-from .errors import DeployError, ModelNotFound
+from .errors import ColdStartTimeout, DeployError, ModelNotFound
 from .metrics import Counters, LatencyWindow
+from .pager import ModelPager, PageRecipe
 
 _RETIRED_KEPT = 4  # retired versions whose metrics stay inspectable
 
@@ -97,6 +98,19 @@ class _Entry:
         self.next_version = 1
         self.warmup_shapes = None
         self.warmup_dtypes = None
+        # weight-pager residency (serving/pager.py).  pager_state is
+        # None for unpaged entries — the ONE read the request path
+        # pays; pager_stamp is the lock-free LRU clock (a plain
+        # monotonic write per request); pager_gen invalidates in-
+        # flight faults across deploy/undeploy; transitions themselves
+        # happen under the pager's own condition, never here.
+        self.pager_state = None
+        self.pager_gen = 0
+        self.pager_stamp = 0.0
+        self.pager_recipe = None
+        self.pager_counters = Counters(
+            "fault_ok", "fault_timeout", "fault_error",
+            "evict_idle", "evict_pressure")
 
 
 class ModelRegistry:
@@ -111,7 +125,7 @@ class ModelRegistry:
     def __init__(self, max_queue: int = 64, max_concurrency: int = 4,
                  default_deadline_ms: Optional[float] = None,
                  priority_classes: Optional[Dict[str, Any]] = None,
-                 tracer=None, **model_defaults: Any):
+                 tracer=None, pager=None, **model_defaults: Any):
         self._max_queue = max_queue
         self._max_concurrency = max_concurrency
         self._default_deadline_ms = default_deadline_ms
@@ -122,6 +136,16 @@ class ModelRegistry:
         # optional observability.Tracer: when set, every predict_ex
         # carries a request span through admission and the data plane
         self.tracer = tracer
+        # optional weight/executable pager (serving/pager.py): a
+        # ModelPager, or its constructor kwargs as a dict (the form a
+        # fleet worker's --registry-json reaches for) — e.g.
+        # pager={"max_resident": 4, "idle_evict_s": 300}
+        if pager is None or isinstance(pager, ModelPager):
+            self._pager = pager
+        else:
+            self._pager = ModelPager(**dict(pager))
+        if self._pager is not None:
+            self._pager.start_reaper()
         self._model_defaults = {
             "supported_concurrent_num": 4, "max_batch_size": 32,
             "coalescing": True, "max_wait_ms": 2.0, **model_defaults}
@@ -167,6 +191,7 @@ class ModelRegistry:
                warmup_shapes=None, warmup_dtypes=None,
                quantize: Optional[bool] = None,
                canary_fraction: Optional[float] = None,
+               pageable: bool = True,
                **model_kwargs: Any) -> int:
         """Deploy ``net`` (a KerasNet/ZooModel), ``jax_fn``+``params``
         (a raw jax forward), or a prebuilt serving handle (``model``,
@@ -191,6 +216,20 @@ class ModelRegistry:
         # serialize whole deploys for this name: versions are allocated
         # inside the lock, so swap order always matches version order
         with entry.deploy_lock:
+            if (canary_fraction is not None and self._pager is not None
+                    and entry.pager_state is not None):
+                # a canary stages WITHOUT swapping the active version,
+                # so there is no safe moment to detach a cold active
+                # from the pager (its handle may be paged out right
+                # now) — pin the entry resident first, explicitly.
+                # Checked INSIDE deploy_lock: attach/detach happen
+                # under it, so a racing pageable deploy cannot slip
+                # this guard.
+                raise DeployError(
+                    f"canary staging is not supported on the paged "
+                    f"entry {name!r} — redeploy with pageable=False "
+                    "(pinning it resident) before staging a canary",
+                    model=name)
             with entry.lock:
                 if version is None:
                     version = entry.next_version
@@ -212,10 +251,13 @@ class ModelRegistry:
 
             # 1. build + load a fresh handle; the live one is never
             # touched
+            prebuilt = model is not None
+            eff_kwargs = {**self._model_defaults, **model_kwargs}
             if model is None:
                 from ..pipeline.inference import InferenceModel
-                im = InferenceModel(
-                    **{**self._model_defaults, **model_kwargs})
+                # store_tag: every executable this deploy persists
+                # carries the registry name it serves (stat --by-model)
+                im = InferenceModel(store_tag=name, **eff_kwargs)
                 try:
                     if net is not None:
                         im.load_keras_net(net, quantize=quantize)
@@ -249,6 +291,16 @@ class ModelRegistry:
                     fail("warmup", e)
 
             dep = _Deployment(version, model)
+
+            # the pager's rebuild recipe is captured BEFORE the swap
+            # (host copies of the weights while the fresh handle is
+            # known-consistent); None when this deploy is not pageable
+            recipe = None
+            if (self._pager is not None and canary_fraction is None
+                    and pageable and not prebuilt):
+                recipe = self._build_recipe(
+                    name, version, model, jax_fn, eff_kwargs,
+                    shapes, dtypes)
 
             # 3. atomic pointer swap (or canary staging) + 4. drain old
             old = None
@@ -288,8 +340,91 @@ class ModelRegistry:
                     f"{name!r} was undeployed (or the registry shut "
                     f"down) while v{version} was building — the new "
                     "version was discarded", model=name, version=version)
+            if self._pager is not None and canary_fraction is None:
+                if recipe is not None:
+                    # the just-swapped version IS resident (freshly
+                    # built); the generation bump inside invalidates
+                    # any in-flight fault of the previous version
+                    self._pager.note_swapped(name, entry, recipe)
+                elif entry.pager_state is not None:
+                    # the new version is not pageable: pin the entry
+                    # resident from here on (safe — the swap installed
+                    # a live handle)
+                    self._pager.detach(name, entry)
             self._retire(entry, old)
         return version
+
+    def _build_recipe(self, name: str, version: int, model, jax_fn,
+                      eff_kwargs: Dict[str, Any], shapes, dtypes
+                      ) -> Optional[PageRecipe]:
+        """The host-side rebuild recipe for a just-built deployment —
+        what a cold entry keeps instead of device memory — or None
+        when the deploy cannot be paged (prebuilt/duck-typed handle,
+        quantized, or decode-capable: a decode engine's slot-array
+        state is live stream context, not pageable weights).
+
+        The recipe's ``build()`` re-runs the fault-in fast path: ONE
+        ``device_put`` of the host weights (``load_jax`` /
+        ``load_graph`` hand the placed tree to the replica set, which
+        aliases rather than re-copies — the PR 5 discipline) and a
+        warmup whose executables rehydrate from the persistent store
+        in milliseconds."""
+        from ..pipeline.inference import InferenceModel
+        if not isinstance(model, InferenceModel):
+            return None
+        if (getattr(model, "_quantize_flag", False)
+                or model._decode_engine is not None):
+            return None
+        import jax
+        import numpy as np
+
+        def host_tree(tree):
+            # explicit device_get: runs at deploy time, transfer-guard
+            # visible, and the result is plain host numpy — a cold
+            # model must pin zero device memory
+            return jax.tree_util.tree_map(
+                lambda a: np.asarray(jax.device_get(a)), tree)
+
+        graph = host_state = None
+        if jax_fn is not None:
+            host_params = host_tree(model._params)
+        elif model._graph is not None:
+            graph = model._graph
+            host_params = host_tree(model._params)
+            host_state = host_tree(model._state)
+        else:
+            return None
+        host_bytes = sum(
+            int(getattr(a, "nbytes", 0)) for a in
+            jax.tree_util.tree_leaves((host_params, host_state)))
+        warm = shapes is not None and model._cache is not None
+
+        # distinct def name on purpose: generation.py's plan cache
+        # calls a local `build()`, and zoolint's name-based hot graph
+        # would weld that hot edge onto this cold deploy-shaped path
+        def _page_rebuild(span=None):
+            im = InferenceModel(store_tag=name, **eff_kwargs)
+            try:
+                if span is not None:
+                    span.phase_start("weights_h2d")
+                if graph is None:
+                    im.load_jax(jax_fn, host_params)
+                else:
+                    im.load_graph(graph, host_params, host_state)
+                if warm:
+                    if span is not None:
+                        span.phase_start("exec_rehydrate")
+                    im.warmup(shapes, dtypes)
+            except BaseException:
+                im.close()
+                raise
+            finally:
+                if span is not None:
+                    span.phase_end()
+            return im
+
+        return PageRecipe(_page_rebuild, host_bytes=host_bytes,
+                          version=version)
 
     def _scale_admission(self, entry: _Entry, dep: _Deployment):
         """Admission concurrency follows the ACTIVE version's replica
@@ -342,7 +477,11 @@ class ModelRegistry:
         executables), which can take up to the drain timeout."""
         if dep is None:
             return
-        dep.model.close()
+        # snapshot: the pager may null dep.model concurrently (a
+        # paged-out deployment has no handle to close)
+        retiring = dep.model
+        if retiring is not None:
+            retiring.close()
         with entry.lock:
             # state flips under entry.lock like every other state write
             # (zoolint ZL401); until the drain above finishes the
@@ -398,12 +537,28 @@ class ModelRegistry:
         tracer = self.tracer
         span = (tracer.start_span(op, trace_id=trace_id, model=name)
                 if tracer is not None else None)
+        # the pager deadline shares the admission clock: a faulting
+        # request queues under ITS deadline (admission wait included),
+        # never a separate cold-start budget.  Computed only when a
+        # pager exists — the unpaged request path stays untouched.
+        pager_deadline = None
+        if self._pager is not None:
+            eff_deadline_ms = (deadline_ms if deadline_ms is not None
+                               else entry.admission.default_deadline_ms)
+            if eff_deadline_ms is not None:
+                pager_deadline = (time.perf_counter()
+                                  + eff_deadline_ms / 1e3)
         try:
             with _trace.activate(span), \
                     entry.admission.admit(deadline_ms=deadline_ms,
                                           span=span,
-                                          priority_class=priority_class):
+                                          priority_class=priority_class
+                                          ) as grant:
                 dep, is_canary = self._route(entry)
+                if self._pager is not None \
+                        and entry.pager_state is not None:
+                    dep = self._pager_serve(entry, dep, pager_deadline,
+                                            span, grant)
                 if span is not None:
                     span.set_label("version", dep.version)
                     if is_canary:
@@ -428,6 +583,56 @@ class ModelRegistry:
         if span is not None:
             info["request_id"] = span.trace_id
         return out, info
+
+    def _pager_serve(self, entry: _Entry, dep: _Deployment,
+                     deadline: Optional[float], span, grant
+                     ) -> _Deployment:
+        """Residency checkout for one admitted request.  The RESIDENT
+        fast path is one state read, a lock-free LRU stamp, and the
+        in-flight counter the evictor's quiesce reads — it NEVER
+        touches the pager lock (the density bench pins this).  Any
+        other state diverts to the shared fault-in, whose wait/build
+        seconds are excluded from the admission service EWMA so a
+        cold start cannot poison predictive shedding."""
+        pager = self._pager
+        for _ in range(32):
+            entry.pager_stamp = time.monotonic()
+            dep.counters.inc("started")
+            if entry.pager_state == "resident":
+                return dep
+            # not usable: balance the in-flight accounting and fault.
+            # The EWMA exclusion lives in a finally: the raise paths
+            # (waiter deadline lapse, late fault, ColdStartTimeout)
+            # spend the SAME wall time, and admission's error-path
+            # release folds service time into the EWMA too — a timed-
+            # out fault must not predictively shed the traffic behind
+            # it any more than a served one
+            dep.counters.inc("aborted")
+            t_fault = time.perf_counter()
+            try:
+                pager.fault_in(entry, deadline=deadline, span=span)
+            finally:
+                if grant is not None:
+                    grant.exclude_service_s(
+                        time.perf_counter() - t_fault)
+            dep, _ = self._route(entry)
+            if entry.pager_state is None:
+                # detached mid-flight (undeploy or a redeploy that
+                # pinned the entry): serve unpaged if a live handle
+                # exists, else the model is gone
+                if dep.model is None:
+                    raise ModelNotFound(
+                        f"model {entry.name!r} was undeployed while "
+                        "cold", model=entry.name)
+                return dep
+        # the thrash 503 is an SLO miss like any other: it must move
+        # the timeout counter the alerting docs point at
+        entry.pager_counters.inc("fault_timeout")
+        raise ColdStartTimeout(
+            f"model {entry.name!r} kept being evicted before this "
+            "request could run — the resident budget is too small for "
+            "the concurrent working set", model=entry.name,
+            thrash=True)
 
     def generate(self, name: str, prompt_ids, max_new_tokens,
                  deadline_ms: Optional[float] = None,
@@ -502,7 +707,13 @@ class ModelRegistry:
     def undeploy(self, name: str, drain_timeout: float = 10.0) -> bool:
         """Remove ``name``: stop admitting, let admitted requests
         finish (graceful drain), then close every version.  Returns
-        True when the drain completed within ``drain_timeout``."""
+        True when the drain completed within ``drain_timeout``.
+
+        Observability is retired WITH the model: the pager forgets the
+        entry (waking any queued faulters, whose in-flight rebuild is
+        generation-invalidated and discarded), and the tracer's span
+        ring drops this model's spans — a paged fleet cycling many
+        models must not accumulate dead models' series or spans."""
         with self._lock:
             entry = self._entries.pop(name, None)
         if entry is None:
@@ -520,8 +731,15 @@ class ModelRegistry:
                 entry.active = entry.canary = None
                 for d in deps:
                     d.state = "retired"
+            if self._pager is not None:
+                self._pager.detach(name, entry)
         for d in deps:
-            d.model.close()
+            m = d.model  # snapshot: paged-out deployments hold None
+            if m is not None:
+                m.close()
+        tracer = self.tracer
+        if tracer is not None and hasattr(tracer, "retire"):
+            tracer.retire(model=name)
         return drained
 
     def shutdown(self, drain_timeout: float = 10.0):
@@ -534,6 +752,13 @@ class ModelRegistry:
                 self.undeploy(n, drain_timeout=drain_timeout)
             except ModelNotFound:
                 pass
+        if self._pager is not None:
+            self._pager.close()
+
+    @property
+    def pager(self) -> Optional[ModelPager]:
+        """The registry's weight pager (None when paging is off)."""
+        return self._pager
 
     def __enter__(self):
         return self
@@ -560,9 +785,12 @@ class ModelRegistry:
                                {"version": canary.version,
                                 "fraction": e.canary_fraction})
                 swaps = e.swap_count
-            serving = (active.model.serving_stats()
-                       if active is not None
-                       and hasattr(active.model, "serving_stats") else {})
+            # a paged-out deployment has no handle: snapshot the model
+            # reference once (the pager may demote concurrently)
+            m_active = active.model if active is not None else None
+            serving = (m_active.serving_stats()
+                       if m_active is not None
+                       and hasattr(m_active, "serving_stats") else {})
             out[n] = {
                 "active_version": active.version if active else None,
                 "canary": canary_info,
@@ -575,4 +803,14 @@ class ModelRegistry:
                 "versions": versions,
                 "serving": serving,
             }
+            pager_state = e.pager_state
+            if self._pager is not None and pager_state is not None:
+                # lock-free reads by design: a scrape must never
+                # contend with (or count as) pager activity
+                out[n]["pager"] = {
+                    "state": pager_state,
+                    "resident": pager_state == "resident",
+                    "idle_s": round(
+                        time.monotonic() - e.pager_stamp, 3),
+                    **e.pager_counters.snapshot()}
         return out

@@ -80,7 +80,14 @@ DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        # speculative window's host fan-out once per
                        # verify dispatch — a stray sync or free-text
                        # log in either taxes every admission / window
-                       "_prefix_lookup", "_process_spec")
+                       "_prefix_lookup", "_process_spec",
+                       # weight pager (serving density): the cold-
+                       # request fault-in and the demotion path — both
+                       # sit between an admitted request and its first
+                       # byte of service, so a stray host sync or
+                       # free-text log there stalls every caller
+                       # queued on the same fault
+                       "fault_in", "_try_evict")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

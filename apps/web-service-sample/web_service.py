@@ -54,6 +54,7 @@ traced request's phases sum to its span wall time, and exits.
 
 import argparse
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -100,16 +101,34 @@ def build_lm():
     return lm
 
 
-def build_registry():
+def build_registry(pager_resident=None):
     """The control plane + observability: one registry with a tracer,
     a Prometheus-exposable metrics registry fed by the control plane /
     tracer / XLA hooks, and the default model deployed and warmed
     before the server accepts traffic.  Returns (registry, obs) where
-    ``obs`` = {"tracer", "metrics", "profile"}."""
+    ``obs`` = {"tracer", "metrics", "profile"}.
+
+    ``pager_resident`` (or ``ZOO_PAGER_RESIDENT``) turns on the weight
+    pager with that resident-model budget: deployments beyond it page
+    out to host memory + the execstore and fault back in on first
+    request (``zoo_model_resident`` / ``zoo_pager_*`` land in the
+    scrape) — the serving-density recipe, one flag."""
     from analytics_zoo_tpu.observability import (MetricsRegistry, Tracer,
                                                  profile)
     from analytics_zoo_tpu.serving import ModelRegistry, registry_collector
 
+    if pager_resident is None:
+        env = os.environ.get("ZOO_PAGER_RESIDENT")
+        try:
+            pager_resident = int(env) if env else None
+        except ValueError:
+            # same degradation as the fleet worker: a typo'd env var
+            # starts the server unpaged, it does not kill it
+            print(f"ignoring malformed ZOO_PAGER_RESIDENT={env!r}",
+                  flush=True)
+            pager_resident = None
+    pager = (None if pager_resident is None
+             else {"max_resident": int(pager_resident)})
     tracer = Tracer(capacity=TRACE_RING)
     # replicas="all": every local device serves — on a multi-chip host
     # each chip holds the executables + params and the coalescer
@@ -126,7 +145,7 @@ def build_registry():
                              priority_classes={
                                  "interactive": (10, 0.9),
                                  "batch": (0, 0.1)},
-                             tracer=tracer)
+                             tracer=tracer, pager=pager)
     metrics = MetricsRegistry()
     metrics.register_collector(registry_collector(registry))
     metrics.register_collector(tracer.families)
@@ -559,9 +578,14 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--self-test", action="store_true")
+    ap.add_argument("--pager-resident", type=int, default=None,
+                    help="serving-density mode: page deployments "
+                         "beyond this resident budget out to host "
+                         "memory + the execstore (default: "
+                         "$ZOO_PAGER_RESIDENT, else off)")
     args = ap.parse_args()
 
-    registry, obs = build_registry()
+    registry, obs = build_registry(pager_resident=args.pager_resident)
     server = ThreadingHTTPServer(("127.0.0.1", args.port),
                                  make_handler(registry, obs))
     port = server.server_address[1]

@@ -3401,6 +3401,328 @@ def coldstart_bench(quick: bool = False, selfcheck: bool = False,
     return rc
 
 
+# -------------------------------------------------------------- density ----
+def _density_config(quick: bool) -> dict:
+    """Shared model recipe for the serving-density drill: N seeded
+    same-architecture MLPs (distinct weights -> distinct outputs, so a
+    cross-model routing mistake is a visible wrong answer) over a
+    resident budget of N/3 — a 3x-overcommitted node."""
+    if quick:
+        return {"n_models": 6, "budget": 2, "layers": 6, "d_in": 32,
+                "max_batch": 8, "requests": 150, "threads": 3,
+                "hot_frac": 0.6, "warm_window": 40,
+                "cold_p99_bound_ms": 3000}
+    return {"n_models": 9, "budget": 3, "layers": 12, "d_in": 64,
+            "max_batch": 16, "requests": 400, "threads": 4,
+            "hot_frac": 0.6, "warm_window": 80,
+            "cold_p99_bound_ms": 3000}
+
+
+def _write_density_trajectory(results: dict, rc: int) -> str:
+    import re as _re
+
+    ns = []
+    for p in glob.glob(os.path.join(REPO, "BENCH_DENSITY_r*.json")):
+        m = _re.search(r"BENCH_DENSITY_r(\d+)\.json$", p)
+        if m:
+            ns.append(int(m.group(1)))
+    n = max(ns, default=0) + 1
+    path = os.path.join(REPO, f"BENCH_DENSITY_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"n": n,
+                   "cmd": "python bench.py density "
+                          + " ".join(sys.argv[2:]),
+                   "rc": rc, "parsed": results}, f, indent=2)
+    return path
+
+
+def density_bench(quick: bool = False, selfcheck: bool = False,
+                  out_path: str = None) -> int:
+    """Serving-density drill (``bench.py density``): deploy 3x more
+    models than the weight pager's resident budget allows, run mixed
+    (hot-set + cold-tail) traffic across ALL of them, and gate:
+
+    * DENSITY_BITEXACT — zero wrong results: every response is
+      bit-identical to an UNPAGED reference registry serving the same
+      weights (store-rehydrated executables are the same binary the
+      reference compiled);
+    * DENSITY_COLD_FAULT — the p99 cold-fault penalty is bounded AND
+      the whole traffic window records zero ``backend_compile``
+      events: a fault is one weights ``device_put`` + an execstore
+      rehydrate, never a recompile (the ms-scale fault-in claim,
+      measured);
+    * DENSITY_RESIDENT_HOTPATH_OK — a resident model's warmed hot
+      path provably never touches the pager: zero pager-lock
+      acquisitions and zero compiles across the window, under the
+      zoolint sanitizer (transfer-guarded, compile-counted);
+    * DENSITY_SCRAPE_OK — the ``zoo_model_resident`` /
+      ``zoo_pager_*`` families ride a parser-clean Prometheus scrape.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+    from jax._src import monitoring
+
+    compile_events = []
+    monitoring.register_event_duration_secs_listener(
+        lambda k, d, **kw: (compile_events.append(k)
+                            if "backend_compile" in k else None))
+
+    import jax.numpy as jnp
+    from analytics_zoo_tpu.observability.metrics import (
+        MetricsRegistry, parse_prometheus_text)
+    from analytics_zoo_tpu.serving import (ModelRegistry, execstore,
+                                           registry_collector)
+
+    cfg = _density_config(quick)
+    work = tempfile.mkdtemp(prefix="zoo_density_")
+    execstore.configure(os.path.join(work, "execstore"))
+    results = {"quick": quick, "config": cfg}
+    ok = True
+
+    n_layers, d_in = cfg["layers"], cfg["d_in"]
+
+    def mlp(p, x):
+        h = x
+        for i in range(n_layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return h
+
+    def mk_params(seed):
+        rng = np.random.default_rng(seed)
+        return {f"w{i}": rng.normal(size=(d_in, d_in)).astype(np.float32)
+                * 0.2 for i in range(n_layers)}
+
+    names = [f"m{i:02d}" for i in range(cfg["n_models"])]
+    params = {n: mk_params(i) for i, n in enumerate(names)}
+    rng = np.random.default_rng(7)
+    evals = {n: rng.normal(size=(cfg["max_batch"] // 2, d_in)
+                           ).astype(np.float32) for n in names}
+
+    try:
+        # ---- unpaged reference: the bit-exactness oracle ----
+        _log(f"density: deploying {cfg['n_models']} models "
+             f"(unpaged reference)")
+        ref = ModelRegistry(max_batch_size=cfg["max_batch"])
+        for n in names:
+            ref.deploy(n, jax_fn=mlp, params=params[n],
+                       warmup_shapes=(d_in,))
+        expected = {n: np.asarray(ref.predict(n, evals[n]))
+                    for n in names}
+
+        # ---- the 3x-overcommitted paged registry ----
+        _log(f"density: deploying paged (budget "
+             f"{cfg['budget']}/{cfg['n_models']} resident)")
+        reg = ModelRegistry(max_batch_size=cfg["max_batch"],
+                            pager={"max_resident": cfg["budget"],
+                                   "fault_timeout_s": 120.0})
+        t0 = time.perf_counter()
+        for n in names:
+            reg.deploy(n, jax_fn=mlp, params=params[n],
+                       warmup_shapes=(d_in,))
+        results["deploy_all_s"] = round(time.perf_counter() - t0, 3)
+        resident0 = reg.pager.resident_count()
+        results["resident_after_deploy"] = resident0
+        if resident0 > cfg["budget"]:
+            _log(f"density FAIL: {resident0} resident after deploys "
+                 f"(budget {cfg['budget']})")
+            ok = False
+
+        # ---- mixed traffic across all models ----
+        # hot set: the first `budget` models take hot_frac of traffic
+        # (they mostly stay resident); the cold tail shares the rest
+        # (constant fault/evict churn at 3x overcommit)
+        trng = np.random.default_rng(11)
+        hot = names[:cfg["budget"]]
+        tail = names[cfg["budget"]:]
+        schedule = [
+            (hot[trng.integers(len(hot))]
+             if trng.random() < cfg["hot_frac"]
+             else tail[trng.integers(len(tail))])
+            for _ in range(cfg["requests"])]
+        sched_lock = threading.Lock()
+        sched_iter = iter(schedule)
+        wrong = []
+        errors = []
+        lat = []  # (cold_before, seconds)
+        c_traffic0 = len(compile_events)
+
+        def client():
+            while True:
+                with sched_lock:
+                    name = next(sched_iter, None)
+                if name is None:
+                    return
+                entry = reg._entries[name]
+                cold = entry.pager_state != "resident"
+                t = time.perf_counter()
+                try:
+                    out = np.asarray(reg.predict(name, evals[name]))
+                except Exception as e:  # noqa: BLE001 — gate counts
+                    errors.append(f"{name}: {type(e).__name__}: {e}")
+                    continue
+                lat.append((cold, time.perf_counter() - t))
+                if not np.array_equal(out, expected[name]):
+                    wrong.append(name)
+
+        _log(f"density: {cfg['requests']} mixed requests over "
+             f"{len(names)} models, {cfg['threads']} threads")
+        threads = [threading.Thread(target=client)
+                   for _ in range(cfg["threads"])]
+        t1 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        traffic_s = time.perf_counter() - t1
+        traffic_compiles = len(compile_events) - c_traffic0
+
+        snap = reg.pager.snapshot()["models"]
+        faults = sum(m["fault_ok"] for m in snap.values())
+        evictions = sum(m["evict_pressure"] + m["evict_idle"]
+                        for m in snap.values())
+        fault_errors = sum(m["fault_error"] + m["fault_timeout"]
+                           for m in snap.values())
+
+        def p99(xs):
+            if not xs:
+                return None
+            xs = sorted(xs)
+            return round(
+                xs[min(len(xs) - 1,
+                       int(round(0.99 * (len(xs) - 1))))] * 1e3, 1)
+
+        cold_lat = [s for c, s in lat if c]
+        warm_lat = [s for c, s in lat if not c]
+        cold_p99, warm_p99 = p99(cold_lat), p99(warm_lat)
+        results.update({
+            "traffic_s": round(traffic_s, 3),
+            "served": len(lat), "wrong": len(wrong),
+            "errors": errors[:5], "n_errors": len(errors),
+            "faults": faults, "evictions": evictions,
+            "fault_errors": fault_errors,
+            "traffic_compiles": traffic_compiles,
+            "cold_requests": len(cold_lat),
+            "cold_p99_ms": cold_p99, "warm_p99_ms": warm_p99,
+        })
+
+        bitexact = (not wrong and not errors
+                    and len(lat) == cfg["requests"])
+        # 3x overcommit that never faulted/evicted measured nothing
+        vacuous = faults == 0 or evictions == 0 or not cold_lat
+        print(f"DENSITY_BITEXACT wrong={len(wrong)} errors={len(errors)}"
+              f" served={len(lat)}/{cfg['requests']} "
+              + ("PASS" if bitexact else "FAIL"), flush=True)
+        cold_ok = (cold_p99 is not None
+                   and cold_p99 <= cfg["cold_p99_bound_ms"]
+                   and traffic_compiles == 0 and fault_errors == 0)
+        print(f"DENSITY_COLD_FAULT p99_ms={cold_p99} "
+              f"warm_p99_ms={warm_p99} faults={faults} "
+              f"evictions={evictions} compiles={traffic_compiles} "
+              f"bound_ms={cfg['cold_p99_bound_ms']} "
+              + ("PASS" if cold_ok and not vacuous else "FAIL"),
+              flush=True)
+
+        # ---- resident hot path: provably pager-free ----
+        from analytics_zoo_tpu.tools.zoolint import sanitize
+        pin = hot[0]
+        reg.predict(pin, evals[pin])  # ensure resident + warmed
+        for _ in range(3):
+            reg.predict(pin, evals[pin])
+        la0 = reg.pager.lock_acquisitions
+        c0 = len(compile_events)
+        hot_err = None
+        try:
+            with sanitize(max_compiles=0):
+                for _ in range(cfg["warm_window"]):
+                    out = np.asarray(reg.predict(pin, evals[pin]))
+                    assert np.array_equal(out, expected[pin])
+        except Exception as e:  # noqa: BLE001 — gate reports it
+            hot_err = f"{type(e).__name__}: {e}"
+        lock_delta = reg.pager.lock_acquisitions - la0
+        win_compiles = len(compile_events) - c0
+        hot_ok = (hot_err is None and lock_delta == 0
+                  and win_compiles == 0)
+        results.update({"hotpath_lock_acq": lock_delta,
+                        "hotpath_compiles": win_compiles,
+                        "hotpath_error": hot_err})
+        print(f"DENSITY_RESIDENT_HOTPATH_{'OK' if hot_ok else 'FAIL'} "
+              f"lock_acq={lock_delta} compiles={win_compiles} "
+              f"sanitize={'clean' if hot_err is None else hot_err} "
+              + ("PASS" if hot_ok else "FAIL"), flush=True)
+
+        # ---- scrape: the pager families round-trip the parser ----
+        mreg = MetricsRegistry()
+        mreg.register_collector(registry_collector(reg))
+        scrape_ok = True
+        try:
+            parsed = parse_prometheus_text(mreg.render_prometheus())
+            fams = {k[0] for k in parsed["samples"]}
+            need = {"zoo_model_resident", "zoo_pager_faults_total",
+                    "zoo_pager_evictions_total"}
+            missing = sorted(need - fams)
+            if missing:
+                _log(f"density FAIL: scrape missing {missing}")
+                scrape_ok = False
+            else:
+                print(f"DENSITY_SCRAPE_OK "
+                      f"samples={len(parsed['samples'])}", flush=True)
+        except ValueError as e:
+            _log(f"density FAIL: unparseable exposition: {e}")
+            scrape_ok = False
+        results["scrape_ok"] = scrape_ok
+
+        if selfcheck:
+            for cond, msg in (
+                    (bitexact, "paged serving returned wrong/failed "
+                               "results"),
+                    (not vacuous, "the overcommitted set never "
+                                  "faulted/evicted — nothing measured"),
+                    (cold_ok, "cold-fault penalty unbounded, a fault "
+                              "compiled, or a fault failed"),
+                    (hot_ok, "resident hot path touched the pager or "
+                             "compiled"),
+                    (scrape_ok, "pager families missing or scrape "
+                                "unparseable")):
+                if not cond:
+                    _log(f"density FAIL: {msg}")
+                    ok = False
+            if ok:
+                _log(f"density selfcheck: {len(lat)} requests over "
+                     f"{cfg['n_models']} models at budget "
+                     f"{cfg['budget']}, {faults} faults "
+                     f"(p99 {cold_p99}ms, 0 compiles), bit-exact, "
+                     "resident hot path pager-free")
+        reg.shutdown()
+        ref.shutdown()
+    except Exception as e:  # noqa: BLE001 — a crashed drill must
+        # still print its report line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        _log(f"density FAIL: {type(e).__name__}: {e}")
+        results["error"] = f"{type(e).__name__}: {e}"
+        ok = False
+    finally:
+        execstore.disable()
+        shutil.rmtree(work, ignore_errors=True)
+
+    print("BENCH_DENSITY " + json.dumps(results), flush=True)
+    rc = 0 if (ok or not selfcheck) else 1
+    if not quick and "error" not in results:
+        path = _write_density_trajectory(results, rc)
+        _log(f"density trajectory written: {os.path.basename(path)}")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+    if selfcheck:
+        print("DENSITY_SELFCHECK_" + ("OK" if ok else "FAIL"),
+              flush=True)
+    return rc
+
+
 # ----------------------------------------------------------- faulttrain ----
 
 def _faulttrain_worker(argv) -> int:
@@ -4272,6 +4594,15 @@ if __name__ == "__main__":
         sys.exit(fleet_bench(quick="--quick" in sys.argv,
                              selfcheck="--selfcheck" in sys.argv,
                              out_path=_out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "density":
+        # single-device on purpose: the pager's subject is MODELS per
+        # device, and one device keeps the resident budget honest
+        _out = None
+        if "--out" in sys.argv:
+            _out = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(density_bench(quick="--quick" in sys.argv,
+                               selfcheck="--selfcheck" in sys.argv,
+                               out_path=_out))
     elif len(sys.argv) > 1 and sys.argv[1] == "loadtest":
         # the elastic gates need >1 device: force 2 virtual host
         # devices BEFORE jax initializes (no-op when the caller — the

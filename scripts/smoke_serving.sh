@@ -125,6 +125,35 @@ grep -q "COLDSTART_SELFCHECK_OK" <<<"$cs" || {
     exit 1
 }
 
+# Serving-density gate: the weight/executable pager under 3x
+# overcommit — 6 models over a 2-model resident budget, mixed traffic
+# across all of them.  Every response must be bit-identical to an
+# unpaged reference registry (DENSITY_BITEXACT wrong=0), every cold
+# fault must be an execstore rehydrate (0 backend_compile events in
+# the whole traffic window, p99 penalty bounded), and a resident
+# model's warmed hot path must provably never touch the pager (zero
+# pager-lock acquisitions + zero compiles, sanitize-clean).
+dn=$(timeout -k 10 590 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    python bench.py density --quick --selfcheck)
+printf '%s\n' "$dn"
+grep -Eq "DENSITY_BITEXACT wrong=0 .*PASS" <<<"$dn" || {
+    echo "smoke FAIL: paged serving returned wrong/failed results" >&2
+    exit 1
+}
+grep -Eq "DENSITY_COLD_FAULT .*compiles=0 .*PASS" <<<"$dn" || {
+    echo "smoke FAIL: cold faults compiled (store did not serve them)" \
+         "or the p99 fault penalty is unbounded" >&2
+    exit 1
+}
+grep -Eq "DENSITY_RESIDENT_HOTPATH_OK lock_acq=0 compiles=0 .*PASS" <<<"$dn" || {
+    echo "smoke FAIL: a resident model's hot path touched the pager" >&2
+    exit 1
+}
+grep -q "DENSITY_SELFCHECK_OK" <<<"$dn" || {
+    echo "smoke FAIL: density selfcheck gates failed" >&2
+    exit 1
+}
+
 # Fleet-serving gate: a 2-worker fleet (real supervised processes,
 # shared execstore) behind the router, under open-loop traffic,
 # through a rolling upgrade AND a SIGKILL'd worker — zero failed

@@ -156,6 +156,42 @@ def test_cli_stat_and_gc(store, capsys):
     assert _entry_files(store) == []
 
 
+def test_stat_by_model_breakdown(store, capsys):
+    """``stat --by-model`` aggregates entries/bytes per the writer's
+    model tag (what a density fleet keeps on disk, per model);
+    untagged entries fold under '-'."""
+    for i in range(2):
+        store.put(store.fingerprint("ncf", i), bytes(256),
+                  meta={"kind": "replica-forward", "model": "ncf"})
+    store.put(store.fingerprint("lm"), bytes(1024),
+              meta={"kind": "decode-plan", "model": "lm"})
+    store.put(store.fingerprint("untagged"), bytes(64),
+              meta={"kind": "demo"})
+    agg = store.by_model()
+    assert agg["ncf"]["entries"] == 2
+    assert agg["lm"]["entries"] == 1 and agg["lm"]["bytes"] > 1024
+    assert agg["-"]["entries"] == 1
+    assert execstore.main(
+        ["--root", store.root, "stat", "--by-model"]) == 0
+    out = capsys.readouterr().out
+    assert "ncf" in out and "lm" in out and "4 entries" in out
+    # biggest consumer prints first (the density question): the lm
+    # entry's 1 KiB payload outweighs ncf's two 256 B ones
+    assert out.index("lm") < out.index("ncf")
+
+
+def test_registry_deploy_tags_entries_with_model_name(store):
+    """The registry threads its model name into every entry the
+    deploy persists — the by-model table is populated end to end."""
+    from analytics_zoo_tpu.serving import ModelRegistry
+
+    with ModelRegistry(max_batch_size=4) as reg:
+        reg.deploy("tagged-mlp", jax_fn=_fwd, params=_mk_params(),
+                   warmup_shapes=(8,))
+    agg = store.by_model()
+    assert agg.get("tagged-mlp", {}).get("entries", 0) >= 1
+
+
 # ------------------------------------------------- ReplicaSet integration
 def _fwd(p, x):
     return jnp.tanh(x @ p["w"] + p["b"])

@@ -18,7 +18,8 @@ import zlib
 import numpy as np
 import pytest
 
-from analytics_zoo_tpu.serving import (DeadlineExceeded, DeployError,
+from analytics_zoo_tpu.serving import (ColdStartTimeout,
+                                       DeadlineExceeded, DeployError,
                                        ModelNotFound, Overloaded,
                                        ServingError)
 from analytics_zoo_tpu.serving.fleet import (FleetRouter,
@@ -127,6 +128,12 @@ def test_crc_mismatch_and_oversize_raise():
      "ModelNotFound", ("model", "nope")),
     (DeployError("warmup blew up", model="m", version=3),
      "DeployError", ("version", 3)),
+    # a worker's cold-start SLO miss crosses as the concrete 503 —
+    # and as a ServingError it is NEVER retried on a sibling, so one
+    # slow fault cannot fan out into every worker faulting the model
+    (ColdStartTimeout("cold past deadline", model="m",
+                      waited_ms=52.1),
+     "ColdStartTimeout", ("waited_ms", 52.1)),
 ])
 def test_error_envelope_fidelity(exc, code, detail):
     """A serving error crossing the wire reconstructs the CONCRETE
@@ -211,6 +218,40 @@ def test_deploy_predict_roundtrip_and_fanout_ordering(make_fleet):
     r.deploy("m", None, STUB, builder_args={"scale": 3.0})
     out, info = r.predict_ex("m", x)
     assert info["version"] == 2 and np.array_equal(out, x * 3.0)
+
+
+def test_undeploy_retires_fleet_series_and_serving(make_fleet):
+    """Router undeploy fans out to every worker AND retires the
+    model's fleet-level series: the per-(model, version) fan-out
+    gauge and the active map are dropped (a density fleet cycling
+    many models must not grow the scrape one dead series per deploy
+    forever), workers stop serving it, and the surviving model is
+    untouched."""
+    r = make_fleet(n_workers=2)
+    r.deploy("gone", None, STUB, builder_args={"scale": 2.0})
+    r.deploy("kept", None, STUB, builder_args={"scale": 3.0})
+    x = np.ones((1, 4))
+    assert np.array_equal(r.predict_ex("gone", x)[0], x * 2.0)
+    fams = {f.name: f for f in r.families()}
+    fanout = fams["zoo_fleet_deploy_fanout_seconds"]
+    assert {s[0]["model"] for s in fanout.samples} == {"gone", "kept"}
+    rep = r.undeploy("gone")
+    assert [a["rank"] for a in rep["activations"]] == [0, 1]
+    assert all(a["model"] == "gone" for a in rep["activations"])
+    with pytest.raises(ModelNotFound):
+        r.predict_ex("gone", x)
+    # fleet series retired; the survivor keeps serving and scraping
+    fams = {f.name: f for f in r.families()}
+    fanout = fams["zoo_fleet_deploy_fanout_seconds"]
+    assert {s[0]["model"] for s in fanout.samples} == {"kept"}
+    assert np.array_equal(r.predict_ex("kept", x)[0], x * 3.0)
+    # the worker-side scrape dropped the model too (the registry
+    # snapshot is the collector — nothing lingers after undeploy)
+    from analytics_zoo_tpu.observability.metrics import \
+        parse_prometheus_text
+    parsed = parse_prometheus_text(r.metrics_text())
+    models = {dict(k[1]).get("model") for k in parsed["samples"]}
+    assert "gone" not in models and "kept" in models
 
 
 def test_router_retries_once_on_worker_death_mid_request(make_fleet):
