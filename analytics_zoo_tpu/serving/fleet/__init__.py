@@ -12,7 +12,17 @@ warm-before-swap activations that hit the shared execstore (zero
 compiles on every worker after the first).  See docs/serving.md
 §"Fleet serving".
 
-* :mod:`.protocol` — length-prefixed CRC-framed JSON envelope codec;
+Fleet v2 (PR 16): the data plane rides a NEGOTIATED binary wire
+(ndarrays out-of-band, zero-copy decode) with per-direction byte
+accounting; routing is residency-aware (workers piggyback their pager
+residency, the scheduler weights least-outstanding-work by it — N
+pagers become one fleet cache); and the pool is elastic
+(:func:`fleet_autoscaler` drives ``FleetRouter.set_pool_size``:
+zero-compile warm scale-up via execstore replay, drain-before-retire
+scale-down).  See docs/serving.md §"Fleet v2".
+
+* :mod:`.protocol` — length-prefixed CRC-framed envelope codec (JSON
+  + binary payloads);
 * :mod:`.artifact` — the committed on-share deploy artifact;
 * :mod:`.builders` — reference artifact builders (mlp, stub);
 * :mod:`.worker` — the worker process (``python -m ...fleet.worker``);
@@ -21,8 +31,8 @@ compiles on every worker after the first).  See docs/serving.md
 """
 
 from . import artifact, builders, protocol
-from .router import FleetRouter, WorkerUnavailable
+from .router import FleetRouter, WorkerUnavailable, fleet_autoscaler
 from .supervisor import FleetSupervisor
 
 __all__ = ["FleetRouter", "FleetSupervisor", "WorkerUnavailable",
-           "artifact", "builders", "protocol"]
+           "fleet_autoscaler", "artifact", "builders", "protocol"]

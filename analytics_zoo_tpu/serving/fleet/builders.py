@@ -75,13 +75,19 @@ class StubModel:
     serving_stats).  ``scale`` makes versions distinguishable
     bit-for-bit; ``delay_s`` shapes latency; ``die_after`` hard-kills
     the PROCESS on the nth predict — the deterministic
-    worker-death-mid-request fixture the router retry tests use."""
+    worker-death-mid-request fixture the router retry tests use;
+    ``expand`` widens each output row N× (trailing axis, so the
+    row count the coalescer splits on is untouched), inflating the
+    REPLY without inflating the request — the oversize-reply degrade
+    fixture."""
 
     def __init__(self, scale: float = 1.0, delay_s: float = 0.0,
                  die_after: Optional[int] = None,
-                 die_rank: Optional[int] = None):
+                 die_rank: Optional[int] = None,
+                 expand: int = 1):
         self.scale = float(scale)
         self.delay_s = float(delay_s)
+        self.expand = int(expand)
         # the death hook follows the train/faults.py one-shot
         # discipline: it only arms on a worker's FIRST incarnation
         # (a restarted worker must not re-die forever) and, with
@@ -108,7 +114,10 @@ class StubModel:
             os._exit(17)
         if self.delay_s:
             time.sleep(self.delay_s)
-        return np.asarray(inputs, dtype=np.float64) * self.scale
+        out = np.asarray(inputs, dtype=np.float64) * self.scale
+        if self.expand > 1:
+            out = np.repeat(out, self.expand, axis=-1)
+        return out
 
     def warmup(self, shapes, dtypes=None) -> float:
         return 0.0
@@ -128,4 +137,5 @@ def stub(args: Dict[str, Any], params: Optional[Dict[str, Any]]
         scale=args.get("scale", 1.0),
         delay_s=args.get("delay_s", 0.0),
         die_after=args.get("die_after"),
-        die_rank=args.get("die_rank"))}
+        die_rank=args.get("die_rank"),
+        expand=args.get("expand", 1))}

@@ -28,19 +28,36 @@ The payload is the existing control-plane envelope verbatim:
   raised in a worker is an ``Overloaded`` with ``evicted=True`` in the
   router's caller, details, http_status and all.
 
-Arrays cross the wire as ``{"__nd__": {dtype, shape, b64}}`` (raw
-``tobytes`` base64) — bit-exact round-trip by construction, which the
-fleet drill's bit-identical gate leans on.
+Two payload encodings share the framing.  The original JSON payload
+carries arrays as ``{"__nd__": {dtype, shape, b64}}`` (raw ``tobytes``
+base64) — bit-exact round-trip by construction, which the fleet
+drill's bit-identical gate leans on, but +33% bytes and an
+encode/decode copy per array per hop.  The v2 BINARY payload
+(:func:`encode_binary`/:func:`decode_binary`) carries ndarrays
+out-of-band: a magic prefix, a compact JSON header holding the
+envelope with each array replaced by a slot reference plus a
+``[dtype, shape, offset, nbytes]`` table, then the raw buffer bytes —
+still one ``sendall``, still one CRC over the whole payload, decoded
+with ``np.frombuffer`` into ZERO-COPY views over the received buffer.
+The first payload byte discriminates (``0xff`` can never begin a JSON
+text), so :func:`recv_envelope` reads either encoding without
+negotiation; which encoding a peer may be SENT is negotiated once per
+connection via the ``hello`` op (old workers answer ``unknown op`` and
+the router falls back to JSON for that connection).
+
+The frame-size bound defaults to 256 MiB and is configurable via
+``ZOO_FLEET_MAX_FRAME`` (bytes).
 """
 
 from __future__ import annotations
 
 import base64
 import json
+import os
 import socket
 import struct
 import zlib
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import errors as _errors
 
@@ -51,19 +68,51 @@ _HEADER = struct.Struct("<II")  # payload length, CRC32(payload)
 #: CRC gets a chance to convict it
 MAX_FRAME_BYTES = 256 << 20
 
+#: wire versions a connection can negotiate (``hello`` op)
+WIRE_JSON = 1
+WIRE_BINARY = 2
+
+#: binary payloads open with a byte no JSON text can start with
+BIN_MAGIC = b"\xffZB2\x00"
+_BIN_HLEN = struct.Struct("<I")
+_BIN_ALIGN = 8  # array buffers land 8-byte aligned for frombuffer
+
+
+def max_frame_bytes() -> int:
+    """The effective frame bound: ``ZOO_FLEET_MAX_FRAME`` (bytes) when
+    set and parseable, else :data:`MAX_FRAME_BYTES`.  Read per call so
+    a worker env override applies without plumbing."""
+    v = os.environ.get("ZOO_FLEET_MAX_FRAME")
+    if v:
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    return MAX_FRAME_BYTES
+
 
 class FrameError(ConnectionError):
     """A torn, short, corrupt, or oversized frame — the stream is no
     longer trustworthy and the connection must be dropped (the router
-    treats it exactly like a worker death: retry on a sibling)."""
+    treats it exactly like a worker death: retry on a sibling).
+    ``attempted_bytes`` is set on the OVERSIZE-send flavor, where no
+    bytes hit the socket: the worker degrades that one to a structured
+    error reply carrying the size instead of dropping the peer."""
+
+    def __init__(self, message: str,
+                 attempted_bytes: Optional[int] = None):
+        super().__init__(message)
+        self.attempted_bytes = attempted_bytes
 
 
 def send_frame(sock: socket.socket, obj: Dict[str, Any]) -> None:
-    """Serialize + send one frame with a single ``sendall``."""
+    """Serialize + send one JSON frame with a single ``sendall``."""
     payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
-    if len(payload) > MAX_FRAME_BYTES:
+    cap = max_frame_bytes()
+    if len(payload) > cap:
         raise FrameError(f"frame of {len(payload)} bytes exceeds the "
-                         f"{MAX_FRAME_BYTES} byte bound")
+                         f"{cap} byte bound",
+                         attempted_bytes=len(payload))
     sock.sendall(_HEADER.pack(len(payload),
                               zlib.crc32(payload) & 0xffffffff)
                  + payload)
@@ -86,23 +135,33 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return b"".join(chunks)
 
 
-def recv_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
-    """Read one frame.  Returns None on a clean EOF at a frame
-    boundary; raises :class:`FrameError` on a torn frame (EOF inside
-    the header or payload), a CRC mismatch, an oversized length, or an
-    undecodable payload."""
+def _recv_payload(sock: socket.socket) -> Optional[bytes]:
+    """One frame's CRC-verified payload bytes (either encoding), or
+    None on a clean EOF at a frame boundary."""
     head = _recv_exact(sock, _HEADER.size)
     if head is None:
         return None
     length, crc = _HEADER.unpack(head)
-    if length > MAX_FRAME_BYTES:
+    cap = max_frame_bytes()
+    if length > cap:
         raise FrameError(f"frame length {length} exceeds the "
-                         f"{MAX_FRAME_BYTES} byte bound")
+                         f"{cap} byte bound")
     payload = _recv_exact(sock, length)
     if payload is None:
         raise FrameError(f"EOF between header and {length}-byte payload")
     if zlib.crc32(payload) & 0xffffffff != crc:
         raise FrameError("frame CRC mismatch")
+    return payload
+
+
+def recv_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    """Read one JSON frame.  Returns None on a clean EOF at a frame
+    boundary; raises :class:`FrameError` on a torn frame (EOF inside
+    the header or payload), a CRC mismatch, an oversized length, or an
+    undecodable payload."""
+    payload = _recv_payload(sock)
+    if payload is None:
+        return None
     try:
         return json.loads(payload.decode("utf-8"))
     except (UnicodeDecodeError, json.JSONDecodeError) as e:
@@ -153,6 +212,155 @@ def decode_value(v: Any) -> Any:
     if isinstance(v, list):
         return [decode_value(x) for x in v]
     return v
+
+
+# ------------------------------------------------------- binary frames
+def _binary_parts(obj: Dict[str, Any]
+                  ) -> Tuple[List[Any], int, int]:
+    """The v2 payload as a list of buffers ready for one join+sendall:
+    ``[magic, header_len, header_json, pad?, buf0, pad?, buf1, ...]``.
+    Arrays are hoisted out of the envelope into slot references so the
+    header stays compact JSON; buffers follow raw, 8-byte aligned,
+    offsets relative to the first buffer region.  Returns
+    ``(parts, total_len, crc32)`` — the CRC is accumulated over the
+    parts so the payload is never materialized twice on the encode
+    side (the decode side is the zero-copy half)."""
+    import numpy as np
+    arrays: List[Any] = []
+
+    def _enc(v: Any) -> Any:
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, np.floating):
+            return float(v)
+        if isinstance(v, (list, tuple)):
+            return [_enc(x) for x in v]
+        if isinstance(v, dict):
+            return {k: _enc(x) for k, x in v.items()}
+        if isinstance(v, np.ndarray) or (
+                hasattr(v, "__array__")
+                and not isinstance(v, (str, bytes, bool, int, float))):
+            a = np.ascontiguousarray(np.asarray(v))
+            arrays.append(a)
+            return {"__ndslot__": len(arrays) - 1}
+        return v
+
+    env = _enc(obj)
+    nd = []
+    off = 0
+    for a in arrays:
+        off += (-off) % _BIN_ALIGN
+        nd.append([str(a.dtype), list(a.shape), off, a.nbytes])
+        off += a.nbytes
+    header = json.dumps({"env": env, "nd": nd},
+                        separators=(",", ":")).encode("utf-8")
+    parts: List[Any] = [BIN_MAGIC, _BIN_HLEN.pack(len(header)), header]
+    pos = 0
+    for a in arrays:
+        pad = (-pos) % _BIN_ALIGN
+        if pad:
+            parts.append(b"\x00" * pad)
+        parts.append(a.data if a.nbytes else b"")
+        pos += pad + a.nbytes
+    total = len(BIN_MAGIC) + _BIN_HLEN.size + len(header) + pos
+    crc = 0
+    for p in parts:
+        crc = zlib.crc32(p, crc)
+    return parts, total, crc & 0xffffffff
+
+
+def encode_binary(obj: Dict[str, Any]) -> bytes:
+    """One envelope as the v2 binary payload (a zoolint hot entry:
+    every negotiated predict/generate request and reply encodes
+    through here)."""
+    parts, _, _ = _binary_parts(obj)
+    return b"".join(parts)
+
+
+def decode_binary(payload: bytes) -> Dict[str, Any]:
+    """The v2 binary payload back into an envelope (a zoolint hot
+    entry).  Array values come back as read-only ``np.frombuffer``
+    views over ``payload`` — ZERO copies; the views keep the buffer
+    alive, and every consumer downstream (coalescer staging, jax
+    device put) copies-on-use anyway."""
+    import numpy as np
+    try:
+        hlen, = _BIN_HLEN.unpack_from(payload, len(BIN_MAGIC))
+        base = len(BIN_MAGIC) + _BIN_HLEN.size
+        header = json.loads(payload[base:base + hlen].decode("utf-8"))
+        body = base + hlen
+        mv = memoryview(payload)
+        views = []
+        for dtype, shape, off, nbytes in header["nd"]:
+            start = body + off
+            views.append(np.frombuffer(
+                mv[start:start + nbytes],
+                dtype=np.dtype(dtype)).reshape(shape))
+    except (struct.error, KeyError, IndexError, ValueError,
+            TypeError, UnicodeDecodeError) as e:
+        raise FrameError(f"undecodable binary payload: "
+                         f"{type(e).__name__}: {e}") from e
+
+    def _dec(v: Any) -> Any:
+        if isinstance(v, dict):
+            if "__ndslot__" in v:
+                return views[v["__ndslot__"]]
+            return {k: _dec(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [_dec(x) for x in v]
+        return v
+
+    return _dec(header["env"])
+
+
+# ------------------------------------------------------ envelope wire
+def send_envelope(sock: socket.socket, obj: Dict[str, Any],
+                  binary: bool = False) -> int:
+    """Send one envelope in the requested encoding, ONE ``sendall``
+    either way; returns the frame's total wire bytes (the router's
+    ``zoo_fleet_wire_bytes_total`` feed).  The oversize check fires
+    BEFORE any bytes hit the socket — the connection stays usable and
+    the caller can degrade to a structured error reply."""
+    if not binary:
+        payload = json.dumps(encode_value(obj),
+                             separators=(",", ":")).encode("utf-8")
+        cap = max_frame_bytes()
+        if len(payload) > cap:
+            raise FrameError(
+                f"frame of {len(payload)} bytes exceeds the {cap} "
+                f"byte bound", attempted_bytes=len(payload))
+        sock.sendall(_HEADER.pack(len(payload),
+                                  zlib.crc32(payload) & 0xffffffff)
+                     + payload)
+        return _HEADER.size + len(payload)
+    parts, total, crc = _binary_parts(obj)
+    cap = max_frame_bytes()
+    if total > cap:
+        raise FrameError(f"frame of {total} bytes exceeds the {cap} "
+                         f"byte bound", attempted_bytes=total)
+    sock.sendall(b"".join([_HEADER.pack(total, crc)] + parts))
+    return _HEADER.size + total
+
+
+def recv_envelope(sock: socket.socket
+                  ) -> Optional[Tuple[Dict[str, Any], int, str]]:
+    """Read one envelope of EITHER encoding (the first payload byte
+    discriminates): ``(envelope, wire_bytes, "binary"|"json")``, or
+    None on a clean EOF at a frame boundary.  JSON payloads get
+    ``decode_value`` applied (``__nd__`` arrays materialize); binary
+    payloads decode to zero-copy views — either way the caller sees
+    plain envelopes with real ndarrays."""
+    payload = _recv_payload(sock)
+    if payload is None:
+        return None
+    nbytes = _HEADER.size + len(payload)
+    if payload.startswith(BIN_MAGIC):
+        return decode_binary(payload), nbytes, "binary"
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise FrameError(f"undecodable frame payload: {e}") from e
+    return decode_value(obj), nbytes, "json"
 
 
 # -------------------------------------------------------------- errors

@@ -9,10 +9,18 @@ fleet-only jobs:
 * **Scheduling** — least-outstanding-work across live workers, ties
   rotated (the ReplicaSet scheduler generalized across processes: one
   outstanding-count per worker instead of one in-flight slot per
-  device).  A connection-level failure mid-request — the worker died
-  under it — is retried ONCE on a sibling, exactly like replica fault
-  tolerance retries a crashed device dispatch in-process; structured
-  serving errors are real rejections and are NEVER retried.
+  device), WEIGHTED BY RESIDENCY (PR 16): workers piggyback their
+  pager residency on every reply, and a request for a model some
+  worker already holds on device pays an ``affinity_penalty`` to land
+  anywhere else — N per-worker pagers behave as ONE fleet cache with
+  effective capacity N×budget, and the penalty (not a hard pin) means
+  a hot resident worker still spills to a sibling under load.
+  Outcomes are counted in ``zoo_fleet_affinity_total{outcome=
+  hit|miss|cold}``.  A connection-level failure mid-request — the
+  worker died under it — is retried ONCE on a sibling, exactly like
+  replica fault tolerance retries a crashed device dispatch
+  in-process; structured serving errors are real rejections and are
+  NEVER retried.
 * **Deploy fan-out** — ``deploy()`` persists the artifact (weights +
   spec) on the share ONCE, then activates the version on each worker
   ONE AT A TIME; every activation is the worker's own
@@ -29,6 +37,30 @@ fleet-only jobs:
   ``zoo_fleet_deploy_fanout_seconds``).  With a tracer installed every
   routed request carries a span with ``route_pick`` / ``worker_call``
   phases and a ``worker`` label.
+
+Fleet v2 additions (PR 16):
+
+* **Binary wire** — each fresh connection negotiates the v2 binary
+  payload encoding with a ``hello`` (old workers answer ``unknown
+  op`` → that connection stays on JSON); negotiated predict/generate
+  requests and replies then carry ndarrays as raw out-of-band buffers
+  (:func:`protocol.encode_binary`), decoded zero-copy.  Per-direction
+  per-encoding byte counts land in
+  ``zoo_fleet_wire_bytes_total{direction,encoding}``.
+* **Cross-process coalescing** — with ``coalesce_ms > 0``, concurrent
+  ``predict`` calls for the same (model, priority, deadline, dtype,
+  trailing-shape) merge into ONE wire request: the first caller
+  becomes the leader, waits the window, concatenates rider rows on
+  axis 0, sends one frame, and splits the reply — PR 2's worker-side
+  coalescer composes through the fleet instead of being defeated by
+  one-row frames.
+* **Elastic pool** — ``set_pool_size`` grows (spawn/revive + the
+  on_worker_up execstore replay = zero-compile warm-up) or shrinks
+  the worker plane; scale-down unroutes the victim, DRAINS its
+  in-flight work, then retires it through the supervisor (no
+  postmortem, no restart).  :func:`fleet_autoscaler` points PR 6's
+  ``Autoscaler`` at this: queue-depth/latency-EWMA signals in,
+  ``set_pool_size`` out.
 
 A restarted worker comes back BLANK: the supervisor's ``on_worker_up``
 hook replays the current version set onto it (warm from store) before
@@ -72,31 +104,50 @@ class _Handle:
         self.rank = rank
         self.port: Optional[int] = None
         self.routable = False
+        # scale-down drain latch: set before draining so neither the
+        # scheduler nor a racing revival probe routes new work at a
+        # worker on its way out
+        self.retiring = False
         self.outstanding = 0
+        # residency piggyback state (PR 16): the models this worker
+        # reported resident on its LAST reply/ping, and its own
+        # in-flight count at that moment.  Whole-object swaps under
+        # the GIL — readers see the old set or the new one, never a
+        # torn set — so the scheduler reads these lock-free.
+        self.resident: frozenset = frozenset()
+        self.worker_inflight = 0
         # the pool is GENERATION-stamped: drop_conns bumps the
         # generation, so an exchange that COMPLETED while straddling a
         # worker death (reply buffered before the kill) cannot return
-        # its dead connection into a pool that was already cleaned
+        # its dead connection into a pool that was already cleaned.
+        # Each pooled conn also carries its NEGOTIATED wire version —
+        # negotiation is per-connection, paid once at connect.
         self.generation = 0
-        self.conns: List[Tuple[int, socket.socket]] = []
+        self.conns: List[Tuple[int, socket.socket, int]] = []
         self.lock = threading.Lock()  # pool only
 
-    def take_conn(self, timeout: float) -> Tuple[socket.socket, int]:
+    def take_conn(self, timeout: float
+                  ) -> Tuple[socket.socket, int, Optional[int]]:
+        """A pooled ``(conn, generation, wire)`` — ``wire`` is None
+        for a FRESH connection (the caller negotiates and passes the
+        verdict back through :meth:`put_conn`)."""
         with self.lock:
             if self.conns:
-                return self.conns.pop()[1], self.generation
+                gen, conn, wire = self.conns.pop()
+                return conn, gen, wire
             port, gen = self.port, self.generation
         if port is None:
             raise ConnectionError(f"worker {self.rank} has no endpoint")
         s = socket.create_connection(("127.0.0.1", port),
                                      timeout=timeout)
         s.settimeout(timeout)
-        return s, gen
+        return s, gen, None
 
-    def put_conn(self, conn: socket.socket, gen: int) -> None:
+    def put_conn(self, conn: socket.socket, gen: int,
+                 wire: int) -> None:
         with self.lock:
             if gen == self.generation:
-                self.conns.append((gen, conn))
+                self.conns.append((gen, conn, wire))
                 return
         try:  # stale generation: the endpoint it reaches is gone
             conn.close()
@@ -107,11 +158,30 @@ class _Handle:
         with self.lock:
             conns, self.conns = self.conns, []
             self.generation += 1
-        for _, c in conns:
+        for _, c, _ in conns:
             try:
                 c.close()
             except OSError:
                 pass
+
+
+class _Batch:
+    """One open cross-process coalescing batch: the FIRST caller for
+    a key is the leader (it waits the window, concatenates, sends one
+    wire request, splits the reply); later callers are riders parked
+    on ``done``.  Rows/sizes are appended under the router's coalesce
+    lock; results/error are written by the leader before ``done``
+    fires."""
+
+    def __init__(self):
+        self.rows: List[Any] = []
+        self.sizes: List[int] = []
+        self.total = 0
+        self.closed = False
+        self.done = threading.Event()
+        self.result = None
+        self.info: Optional[Dict[str, Any]] = None
+        self.error: Optional[BaseException] = None
 
 
 class FleetRouter:
@@ -131,10 +201,27 @@ class FleetRouter:
                  max_restarts: int = 2, restart_backoff: float = 0.5,
                  watchdog_sec: float = 0.0,
                  call_timeout_s: float = 120.0,
+                 wire: str = "binary",
+                 affinity_penalty: int = 4,
+                 coalesce_ms: float = 0.0,
+                 coalesce_rows: int = 64,
                  tracer=None):
         self.share_dir = os.path.abspath(share_dir)
         os.makedirs(self.share_dir, exist_ok=True)
         self.call_timeout_s = call_timeout_s
+        # "binary" negotiates the v2 wire per connection (old/pinned
+        # workers degrade that connection to JSON); "json" skips the
+        # hello entirely — the A/B lever the fleet drill measures with
+        self.wire = wire
+        # affinity: a non-resident worker's score is outstanding +
+        # penalty, so residency wins until the resident worker is
+        # ~penalty requests deeper than a sibling — a soft pin that
+        # load can override (hard pinning would turtle one worker)
+        self.affinity_penalty = affinity_penalty
+        # cross-process coalescing window (0 = off): concurrent
+        # same-key predicts merge into one wire request
+        self.coalesce_ms = coalesce_ms
+        self.coalesce_rows = coalesce_rows
         self.tracer = tracer
         worker_env = dict(env or {})
         if not fake:
@@ -160,6 +247,15 @@ class FleetRouter:
         self._rr = 0
         self._retries_total = 0
         self._req_seq = 0
+        # v2 telemetry: affinity outcomes, per-(direction, encoding)
+        # wire bytes, and a served-latency EWMA (the autoscaler's
+        # pressure signal alongside queue depth)
+        self._affinity = {"hit": 0, "miss": 0, "cold": 0}
+        self._wire_bytes: Dict[Tuple[str, str], int] = {}
+        self._ewma_ms: Optional[float] = None
+        # coalescer: one open batch per key, leader/rider protocol
+        self._co_lock = threading.Lock()
+        self._co_open: Dict[Any, "_Batch"] = {}
         self._fanouts: Dict[Tuple[str, int], float] = {}
         self.last_fanout: List[Dict[str, Any]] = []
         # rank -> the replay-activation reports of its LAST (re)start
@@ -232,12 +328,45 @@ class FleetRouter:
         h.routable = True
 
     # ---- wire calls ----
+    def _negotiate(self, conn: socket.socket, rank: int) -> int:
+        """Per-connection wire handshake: one ``hello`` exchange.  An
+        old worker (or one pinned with ``ZOO_FLEET_WIRE=json``)
+        answers without a binary verdict and the connection stays on
+        the v1 JSON wire — mixed fleets interoperate per-connection.
+        Transport failures propagate (the caller's normalizing try
+        owns them)."""
+        if self.wire != "binary":
+            return protocol.WIRE_JSON
+        protocol.send_frame(conn, {"op": "hello", "id": 0,
+                                   "wire": protocol.WIRE_BINARY})
+        resp = protocol.recv_frame(conn)
+        if resp is None:
+            raise protocol.FrameError(
+                f"worker {rank} hung up during wire negotiation")
+        if (resp.get("ok")
+                and isinstance(resp.get("result"), dict)
+                and resp["result"].get("wire")
+                == protocol.WIRE_BINARY):
+            return protocol.WIRE_BINARY
+        return protocol.WIRE_JSON
+
+    def _count_wire(self, direction: str, encoding: str,
+                    nbytes: int) -> None:
+        with self._lock:
+            key = (direction, encoding)
+            self._wire_bytes[key] = self._wire_bytes.get(key, 0) \
+                + nbytes
+
     def _call(self, h: _Handle, req: Dict[str, Any]) -> Dict[str, Any]:
         """One request/reply exchange with one worker on a pooled
         connection.  Any transport-level failure closes the connection
         and surfaces as ConnectionError (the worker-death signal);
         a structured error envelope raises the reconstructed serving
-        exception."""
+        exception.  Serve-op payloads ride the negotiated wire
+        (binary: ndarrays as raw out-of-band buffers, zero-copy on
+        decode); control ops stay JSON — no arrays, and a readable
+        envelope is worth more than the few bytes.  Every reply's
+        ``load`` piggyback refreshes this handle's residency view."""
         with self._lock:
             self._req_seq += 1
             req = {**req, "id": self._req_seq}
@@ -247,9 +376,13 @@ class FleetRouter:
             # hangs raises TimeoutError, which is an OSError but NOT
             # a ConnectionError — without normalization a wedged
             # accept loop would escape the retry-on-sibling contract
-            conn, gen = h.take_conn(self.call_timeout_s)
-            protocol.send_frame(conn, req)
-            resp = protocol.recv_frame(conn)
+            conn, gen, wire = h.take_conn(self.call_timeout_s)
+            if wire is None:
+                wire = self._negotiate(conn, h.rank)
+            binary = (wire == protocol.WIRE_BINARY
+                      and req.get("op") in ("predict", "generate"))
+            n_tx = protocol.send_envelope(conn, req, binary=binary)
+            got = protocol.recv_envelope(conn)
         except (OSError, protocol.FrameError) as e:
             if conn is not None:
                 try:
@@ -259,6 +392,10 @@ class FleetRouter:
             raise ConnectionError(
                 f"worker {h.rank} failed mid-request: "
                 f"{type(e).__name__}: {e}") from e
+        self._count_wire("tx", "binary" if binary else "json", n_tx)
+        if got is not None:
+            self._count_wire("rx", got[2], got[1])
+        resp = got[0] if got is not None else None
         if resp is None or resp.get("id") != req["id"]:
             try:
                 conn.close()
@@ -266,26 +403,56 @@ class FleetRouter:
                 pass
             raise ConnectionError(
                 f"worker {h.rank} hung up mid-request")
-        h.put_conn(conn, gen)
+        h.put_conn(conn, gen, wire)
+        load = resp.get("load")
+        if isinstance(load, dict):
+            # whole-object swaps, read lock-free by the scheduler
+            h.resident = frozenset(load.get("r") or ())
+            h.worker_inflight = int(load.get("o") or 0)
         if not resp.get("ok"):
             raise protocol.decode_error(resp.get("error") or {})
         return resp
 
-    def _pick(self, exclude: Optional[int] = None) -> _Handle:
+    def _pick(self, exclude: Optional[int] = None,
+              model: Optional[str] = None,
+              count: bool = True) -> _Handle:
         """Least-outstanding-work over routable workers, ties rotated
-        (pure min-index would camp light traffic on worker 0)."""
+        (pure min-index would camp light traffic on worker 0),
+        residency-weighted when a model is named: a worker NOT
+        holding the model scores ``outstanding + affinity_penalty``,
+        so requests follow residency until load outweighs the fault
+        cost.  Outcomes: ``hit`` — a resident worker chosen; ``miss``
+        — someone holds it but load sent us elsewhere; ``cold`` — no
+        live worker holds it (somebody must fault).  The retry-on-
+        sibling re-pick passes ``count=False`` — one request, one
+        outcome."""
         with self._lock:
             live = [h for h in self.handles
-                    if h.routable and h.rank != exclude]
+                    if h.routable and not h.retiring
+                    and h.rank != exclude]
             if not live:
                 raise WorkerUnavailable(
                     "no live fleet worker available",
                     states=self.supervisor.states())
-            best = min(h.outstanding for h in live)
-            candidates = [h for h in live if h.outstanding == best]
+            if model is None:
+                score = {h.rank: h.outstanding for h in live}
+            else:
+                score = {h.rank: h.outstanding
+                         + (0 if model in h.resident
+                            else self.affinity_penalty)
+                         for h in live}
+            best = min(score.values())
+            candidates = [h for h in live if score[h.rank] == best]
             h = candidates[self._rr % len(candidates)]
             self._rr += 1
             h.outstanding += 1
+            if model is not None and count:
+                if model in h.resident:
+                    self._affinity["hit"] += 1
+                elif any(model in x.resident for x in live):
+                    self._affinity["miss"] += 1
+                else:
+                    self._affinity["cold"] += 1
             return h
 
     def _release(self, h: _Handle) -> None:
@@ -322,15 +489,16 @@ class FleetRouter:
                     time.sleep(delay)
                     delay = min(delay * 2, 2.0)
                     continue
-                h.routable = True
+                if not h.retiring:
+                    h.routable = True
                 _slog.info("fleet_worker_revived", rank=h.rank)
                 return
         finally:
             with self._lock:
                 self._reviving.discard(h.rank)
 
-    def _route_call(self, req: Dict[str, Any], span=None
-                    ) -> Dict[str, Any]:
+    def _route_call(self, req: Dict[str, Any], span=None,
+                    model: Optional[str] = None) -> Dict[str, Any]:
         """The routed data path (a zoolint hot entry): pick, call,
         and on a worker death retry ONCE on a sibling.  The failed
         worker is marked unroutable immediately; a detached revival
@@ -341,7 +509,7 @@ class FleetRouter:
         successful ping, never forever."""
         if span is not None:
             span.phase_start("route_pick")
-        h = self._pick()
+        h = self._pick(model=model)
         if span is not None:
             span.set_label("worker", h.rank)
             span.phase_start("worker_call")
@@ -357,7 +525,7 @@ class FleetRouter:
                           op=req.get("op"))
             if span is not None:
                 span.set_label("retried", True)
-            h2 = self._pick(exclude=h.rank)
+            h2 = self._pick(exclude=h.rank, model=model, count=False)
             if span is not None:
                 span.set_label("worker", h2.rank)
             try:
@@ -381,9 +549,17 @@ class FleetRouter:
                    trace_id: Optional[str] = None,
                    priority_class: Optional[str] = None
                    ) -> Tuple[Any, Dict[str, Any]]:
+        # inputs stay RAW ndarrays in the request envelope — the
+        # encoding decision (binary out-of-band vs JSON b64) belongs
+        # to the negotiated connection at send time, not here
+        if self.coalesce_ms > 0:
+            import numpy as np
+            x = np.asarray(inputs)
+            if x.ndim >= 2:
+                return self._predict_coalesced(
+                    model, x, deadline_ms, trace_id, priority_class)
         return self._serve_ex(
-            {"op": "predict", "model": model,
-             "inputs": protocol.encode_value(inputs)},
+            {"op": "predict", "model": model, "inputs": inputs},
             model, "predict", deadline_ms, trace_id, priority_class)
 
     def generate_ex(self, model: str, prompt_ids, max_new_tokens: int,
@@ -402,7 +578,7 @@ class FleetRouter:
         # worker == the single-process registry, bit-exact
         return self._serve_ex(
             {"op": "generate",
-             "prompt_ids": protocol.encode_value(prompt_ids),
+             "prompt_ids": prompt_ids,
              "model": model, "max_new_tokens": int(max_new_tokens),
              "eos_id": eos_id, "temperature": float(temperature),
              "top_k": None if top_k is None else int(top_k),
@@ -424,9 +600,10 @@ class FleetRouter:
             req["trace_id"] = span.trace_id
         elif trace_id is not None:
             req["trace_id"] = trace_id
+        t0 = time.perf_counter()
         try:
             with _trace.activate(span):
-                resp = self._route_call(req, span=span)
+                resp = self._route_call(req, span=span, model=model)
         except BaseException as e:
             if span is not None:
                 span.set_label("error", type(e).__name__)
@@ -434,10 +611,84 @@ class FleetRouter:
         finally:
             if span is not None:
                 span.finish()
+        ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            # served-latency EWMA: the autoscaler's pressure signal
+            self._ewma_ms = (ms if self._ewma_ms is None
+                             else 0.2 * ms + 0.8 * self._ewma_ms)
         info = dict(resp.get("info") or {})
         if span is not None:
             info["request_id"] = span.trace_id
         return protocol.decode_value(resp.get("result")), info
+
+    # ---- cross-process coalescing ----
+    def _predict_coalesced(self, model: str, x, deadline_ms,
+                           trace_id, priority_class
+                           ) -> Tuple[Any, Dict[str, Any]]:
+        """Merge concurrent compatible predicts into ONE wire request
+        (leader/rider).  Compatibility is the batching contract: same
+        model, priority class, deadline value, dtype, and trailing
+        shape — rows concatenate on axis 0 exactly like the worker's
+        own coalescer merges them, so the fleet answer stays
+        bit-exact vs per-request sends.  Riders share the leader's
+        outcome, including its error: a shed batch sheds every
+        caller, same as the in-process coalescer."""
+        import numpy as np
+        key = (model, priority_class, deadline_ms,
+               str(x.dtype), x.shape[1:])
+        with self._co_lock:
+            b = self._co_open.get(key)
+            if (b is not None and not b.closed
+                    and b.total + len(x) <= self.coalesce_rows):
+                my_off = b.total
+                b.rows.append(x)
+                b.sizes.append(len(x))
+                b.total += len(x)
+                leader = False
+            else:
+                b = _Batch()
+                b.rows.append(x)
+                b.sizes.append(len(x))
+                b.total = len(x)
+                self._co_open[key] = b
+                leader = True
+        if not leader:
+            # the leader's serve carries the deadline; the extra
+            # margin only guards against a lost leader thread
+            if not b.done.wait(self.call_timeout_s + 30.0):
+                raise WorkerUnavailable(
+                    "coalesced batch leader never completed",
+                    model=model)
+            if b.error is not None:
+                raise b.error
+            out = b.result[my_off:my_off + len(x)]
+            info = dict(b.info or {})
+            info["coalesced"] = b.total
+            return out, info
+        time.sleep(self.coalesce_ms / 1e3)  # the gather window
+        with self._co_lock:
+            if self._co_open.get(key) is b:
+                del self._co_open[key]
+            b.closed = True
+            rows = list(b.rows)
+        batch = rows[0] if len(rows) == 1 else np.concatenate(rows)
+        try:
+            out, info = self._serve_ex(
+                {"op": "predict", "model": model, "inputs": batch},
+                model, "predict", deadline_ms, trace_id,
+                priority_class)
+            b.result = np.asarray(out)
+            b.info = info
+        except BaseException as e:  # noqa: BLE001 — riders must see
+            # the leader's failure, whatever its class
+            b.error = e
+            raise
+        finally:
+            b.done.set()
+        info = dict(info)
+        if len(rows) > 1:
+            info["coalesced"] = b.total
+        return b.result[:b.sizes[0]], info
 
     # ---- deploy / fan-out ----
     def deploy(self, model: str, params: Optional[Dict[str, Any]],
@@ -571,12 +822,115 @@ class FleetRouter:
         return self._call(self.handles[rank],
                           {"op": "ping"})["result"]
 
+    # ---- elastic pool ----
+    def pool_size(self) -> int:
+        """Workers that count toward capacity: everything not
+        deliberately retired and not past its restart budget."""
+        return sum(1 for w in self.supervisor.workers
+                   if w.state not in ("retired", "dead"))
+
+    def load_signals(self) -> Dict[str, Any]:
+        """The autoscaler's view of the fleet: router-side in-flight
+        total (the timely number — worker piggybacks lag one reply),
+        the served-latency EWMA, and the live pool size."""
+        with self._lock:
+            depth = sum(h.outstanding for h in self.handles)
+            ewma = self._ewma_ms
+        return {"queue_depth": depth, "ewma_ms": ewma,
+                "active": self.pool_size()}
+
+    def set_pool_size(self, n: int, *, drain_timeout_s: float = 30.0,
+                      start_timeout_s: float = 120.0
+                      ) -> Dict[str, Any]:
+        """Resize the worker plane to ``n`` workers (the autoscaler's
+        ``apply_scale``, also a first-class operator verb).
+
+        Scale-UP revives retired slots first, then appends fresh
+        ranks; either way the supervisor's ``on_worker_up`` replay
+        warms the newcomer from the shared execstore BEFORE it turns
+        routable — zero compiles, gated by the fleet drill — and this
+        call blocks until the newcomer is routable (the autoscaler
+        contract: apply_scale is synchronous).
+
+        Scale-DOWN picks the highest-rank active workers, latches
+        ``retiring`` (no new picks, revival probes disarmed), DRAINS
+        the router-side in-flight count to zero, then retires the
+        process through the supervisor — a deliberate exit, not an
+        incident.  A drain that outlives ``drain_timeout_s`` retires
+        anyway (the straggler's caller gets the retry-on-sibling
+        path) and reports ``forced``."""
+        if n < 1:
+            raise ValueError(f"pool size must be >= 1, got {n}")
+        report: Dict[str, Any] = {"target": n, "grew": [],
+                                  "retired": [], "forced": []}
+        while self.pool_size() < n:
+            retired = [w for w in self.supervisor.workers
+                       if w.state == "retired"]
+            if retired:
+                rank = retired[0].rank
+                h = self.handles[rank]
+                h.retiring = False
+                h.drop_conns()
+                self.supervisor.revive(rank)
+            else:
+                with self._lock:
+                    rank = len(self.supervisor.workers)
+                    # the handle EXISTS before the spawn: the monitor
+                    # thread's on_worker_up replay dereferences it
+                    self.handles.append(_Handle(rank))
+                self.supervisor.add_worker()
+            deadline = time.monotonic() + start_timeout_s
+            h = self.handles[rank]
+            while not h.routable:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"scale-up worker {rank} not routable within "
+                        f"{start_timeout_s}s: "
+                        f"{self.supervisor.states()}")
+                if self.supervisor.worker(rank).state == "dead":
+                    raise RuntimeError(
+                        f"scale-up worker {rank} died during warm-up")
+                time.sleep(0.02)
+            report["grew"].append(rank)
+            _slog.info("fleet_scale_up", rank=rank,
+                       pool=self.pool_size())
+        while self.pool_size() > n:
+            active = [w for w in self.supervisor.workers
+                      if w.state not in ("retired", "dead")]
+            victim = max(active, key=lambda w: w.rank)
+            h = self.handles[victim.rank]
+            h.retiring = True
+            h.routable = False
+            deadline = time.monotonic() + drain_timeout_s
+            while True:
+                with self._lock:
+                    drained = h.outstanding == 0
+                if drained:
+                    break
+                if time.monotonic() > deadline:
+                    report["forced"].append(victim.rank)
+                    _slog.warning("fleet_scale_down_forced",
+                                  rank=victim.rank,
+                                  outstanding=h.outstanding)
+                    break
+                time.sleep(0.01)
+            h.drop_conns()
+            h.port = None
+            h.resident = frozenset()
+            self.supervisor.retire(victim.rank)
+            report["retired"].append(victim.rank)
+            _slog.info("fleet_scale_down", rank=victim.rank,
+                       pool=self.pool_size())
+        return report
+
     # ---- observability ----
     def families(self) -> List[Family]:
         states = self.supervisor.states()
         with self._lock:
             retries = self._retries_total
             fanouts = dict(self._fanouts)
+            affinity = dict(self._affinity)
+            wire_bytes = dict(self._wire_bytes)
         fams = [
             Family("gauge", "zoo_fleet_workers",
                    "fleet workers by supervision state",
@@ -584,6 +938,18 @@ class FleetRouter:
             Family("counter", "zoo_fleet_router_retries_total",
                    "requests retried on a sibling after a worker "
                    "death mid-request", [({}, retries)]),
+            Family("counter", "zoo_fleet_affinity_total",
+                   "residency-aware routing outcomes (hit: landed "
+                   "on a worker holding the model; miss: resident "
+                   "worker existed but load won; cold: nobody held "
+                   "it)",
+                   [({"outcome": o}, n)
+                    for o, n in sorted(affinity.items())]),
+            Family("counter", "zoo_fleet_wire_bytes_total",
+                   "router<->worker frame bytes by direction and "
+                   "payload encoding",
+                   [({"direction": d, "encoding": e}, n)
+                    for (d, e), n in sorted(wire_bytes.items())]),
         ]
         if fanouts:
             fams.append(Family(
@@ -619,3 +985,44 @@ class FleetRouter:
     def retries_total(self) -> int:
         with self._lock:
             return self._retries_total
+
+    @property
+    def affinity_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._affinity)
+
+    @property
+    def wire_bytes(self) -> Dict[Tuple[str, str], int]:
+        with self._lock:
+            return dict(self._wire_bytes)
+
+    def set_wire(self, wire: str) -> None:
+        """Flip the fleet's wire mode ("binary" negotiates v2 per
+        connection, "json" pins v1) and drop every pooled connection
+        so the next exchange renegotiates — the drill's A/B lever."""
+        if wire not in ("binary", "json"):
+            raise ValueError(f"wire must be binary|json, got {wire!r}")
+        self.wire = wire
+        for h in list(self.handles):
+            h.drop_conns()
+
+
+def fleet_autoscaler(router: FleetRouter, **kwargs: Any):
+    """PR 6's :class:`~..autoscale.Autoscaler` pointed at the WORKER
+    PLANE: queue depth = the router's in-flight total, latency = its
+    served EWMA, and ``apply_scale`` resizes the worker pool through
+    :meth:`FleetRouter.set_pool_size` — whole processes instead of
+    in-process replicas, with the execstore replay making every
+    scale-up warm.  Same hysteresis/cooldown/±1 discipline, same
+    testable ``tick()``.  ``max_replicas`` defaults to the current
+    pool size (growing past the initial fleet is an explicit
+    decision, not a default)."""
+    from ..autoscale import Autoscaler
+
+    def apply_scale(n: int):
+        router.set_pool_size(n)
+
+    kwargs.setdefault("max_replicas", router.pool_size())
+    kwargs.setdefault("initial_replicas", router.pool_size())
+    kwargs.setdefault("name", "fleet")
+    return Autoscaler(router.load_signals, apply_scale, **kwargs)

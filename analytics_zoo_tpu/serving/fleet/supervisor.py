@@ -24,6 +24,13 @@ Per worker, per incident:
 * past the budget the worker is ``dead`` and stays dead — the fleet
   degrades rather than crash-looping (``zoo_fleet_workers{state}``
   makes the degradation visible).
+
+The pool is ELASTIC (PR 16): ``add_worker``/``revive`` grow it (the
+new worker warms from the shared execstore via the same
+``on_worker_up`` replay, so scale-up is zero-compile), and
+``retire`` is the deliberate scale-down terminal — marked BEFORE the
+terminate so the monitor never mistakes a drained worker's exit for
+a crash.  The router owns the drain discipline around these.
 """
 
 from __future__ import annotations
@@ -53,7 +60,11 @@ class _WorkerProc:
         self.proc: Optional[subprocess.Popen] = None
         self.incarnation = 0
         self.restarts = 0
-        self.state = "restarting"  # live | restarting | dead
+        # live | restarting | dead | retired — ``retired`` is the
+        # elastic-pool scale-down terminal: deliberate, drained, NOT
+        # an incident (no postmortem, no restart budget spent); the
+        # slot can be revived by a later scale-up
+        self.state = "restarting"
         self.port: Optional[int] = None
         self.port_file = ""
         self.hb_path = ""
@@ -160,8 +171,8 @@ class FleetSupervisor:
         watchdog."""
         while not self._stopping:
             now = time.monotonic()
-            for w in self.workers:
-                if w.state == "dead":
+            for w in list(self.workers):
+                if w.state in ("dead", "retired"):
                     continue
                 if w.proc is not None:
                     rc = w.proc.poll()
@@ -225,6 +236,10 @@ class FleetSupervisor:
         decision.  Heartbeat age is sampled at detection (the
         postmortem must reflect what the watchdog saw, not what the
         reap left behind)."""
+        if w.state == "retired":
+            # a deliberate retire whose exit the poll caught before
+            # the state check: not an incident, no postmortem
+            return
         reason = w.last_reason or "exit"
         w.last_reason = None
         age = self._hb_age(w)
@@ -270,9 +285,58 @@ class FleetSupervisor:
         _slog.warning("fleet_worker_restarting", rank=w.rank,
                       restart=w.restarts, backoff_s=round(backoff, 3))
 
+    # ---- elastic pool ----
+    def add_worker(self) -> int:
+        """Scale-up: append a fresh worker slot and spawn it (the
+        monitor promotes it live once its port file lands, firing
+        ``on_worker_up`` — the execstore replay warm happens there,
+        so a scale-up worker joins at zero compiles).  Returns the
+        new rank."""
+        with self._lock:
+            w = _WorkerProc(len(self.workers))
+            self.workers.append(w)
+        self._spawn(w)
+        _slog.info("fleet_worker_added", rank=w.rank)
+        return w.rank
+
+    def revive(self, rank: int) -> None:
+        """Scale-up into a previously retired slot: a fresh
+        incarnation with a fresh restart budget (retirement was
+        deliberate, not a crash record to hold against it)."""
+        w = self.workers[rank]
+        if w.state != "retired":
+            raise ValueError(f"worker {rank} is {w.state}, not retired")
+        w.restarts = 0
+        w.incarnation += 1
+        w.restart_at = 0.0
+        self._spawn(w)
+        _slog.info("fleet_worker_revived_slot", rank=rank)
+
+    def retire(self, rank: int, grace_s: float = 5.0) -> None:
+        """Scale-down terminal for one DRAINED worker: mark retired
+        FIRST (so the monitor treats the exit as deliberate — no
+        postmortem, no restart), then terminate → grace → kill →
+        reap.  The caller owns the drain: no new work routed and
+        in-flight requests completed before calling this."""
+        w = self.workers[rank]
+        w.state = "retired"
+        w.port = None
+        p, w.proc = w.proc, None
+        if p is not None and p.poll() is None:
+            p.terminate()
+            try:
+                p.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        _slog.info("fleet_worker_retired", rank=rank)
+
     # ---- introspection ----
     def states(self) -> Dict[str, int]:
-        out = {"live": 0, "restarting": 0, "dead": 0}
+        out = {"live": 0, "restarting": 0, "dead": 0, "retired": 0}
         for w in self.workers:
             out[w.state] = out.get(w.state, 0) + 1
         return out

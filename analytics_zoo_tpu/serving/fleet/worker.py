@@ -30,6 +30,14 @@ Supervision contract (the PR 10 machinery, reused):
   its own traffic's working set on device.  ``--registry-json
   '{"pager": {...}}'`` configures the full knob set and wins over
   the env;
+* ``ZOO_FLEET_WIRE=json`` — pin this worker's NEGOTIATED reply wire
+  to the v1 JSON encoding (it still decodes binary requests); the
+  router's per-connection ``hello`` discovers this and keeps that
+  connection on JSON — the fleet-wide escape hatch for the v2 binary
+  wire, and how mixed-version fleets interoperate;
+* ``ZOO_FLEET_MAX_FRAME`` — frame-size cap in bytes (default 256
+  MiB); an oversize REPLY degrades to a structured error envelope
+  carrying ``attempted_bytes`` instead of a dropped connection;
 * the port file is written ATOMICALLY once the socket is listening —
   its presence is the router's readiness signal, and a restarted
   incarnation's fresh port lands the same way.
@@ -90,6 +98,21 @@ class ServingWorker:
         self._hb_last = 0.0
         self._compile_events: List[str] = []
         self._compile_hooked = False
+        # v2 wire ceiling this worker will NEGOTIATE down to:
+        # ZOO_FLEET_WIRE=json pins the fleet to the v1 JSON wire (the
+        # negotiation-fallback test hook, and the escape hatch if a
+        # binary-wire bug ever ships) — the worker still DECODES
+        # either encoding regardless
+        self.wire_max = (protocol.WIRE_JSON
+                         if os.environ.get("ZOO_FLEET_WIRE") == "json"
+                         else protocol.WIRE_BINARY)
+        # load piggyback: serve-op in-flight count plus a throttled
+        # residency snapshot, attached to every reply (and ping) so
+        # the router's affinity view refreshes for free on the data
+        # path instead of needing a polling control op
+        self._inflight = 0
+        self._load_lock = threading.Lock()
+        self._res_cache: tuple = (0.0, None)
         self._stop = threading.Event()
         self._listener: Optional[socket.socket] = None
         self._conn_threads: List[threading.Thread] = []
@@ -169,27 +192,55 @@ class ServingWorker:
             pass
         self.registry.shutdown()
 
+    def _load_snapshot(self) -> Dict[str, Any]:
+        """The per-reply load piggyback: in-flight serve ops plus the
+        residency list, the latter recomputed at most every ~50ms (a
+        dict walk, but not per-request at fleet QPS)."""
+        now = time.monotonic()
+        with self._load_lock:
+            out = self._inflight
+            ts, res = self._res_cache
+            if res is not None and now - ts <= 0.05:
+                return {"o": out, "r": res}
+        res = self.registry.resident_models()
+        with self._load_lock:
+            self._res_cache = (now, res)
+            out = self._inflight
+        return {"o": out, "r": res}
+
     def _serve_conn(self, conn: socket.socket) -> None:
         """One connection's request/reply loop (a zoolint hot entry:
         this is the per-request path).  Frame errors and hangups end
         the connection; op errors travel back as structured error
-        envelopes — the connection survives a shed request."""
+        envelopes — the connection survives a shed request.
+
+        The reply encoding is per-connection state: JSON until the
+        peer negotiates the binary wire with a ``hello`` (whose REPLY
+        is still JSON — the peer does not know the verdict yet);
+        requests decode as whatever they arrived as, no negotiation
+        needed (the payload's first byte discriminates)."""
+        wire = protocol.WIRE_JSON
         try:
             while not self._stop.is_set():
-                req = protocol.recv_frame(conn)
-                if req is None:
+                got = protocol.recv_envelope(conn)
+                if got is None:
                     return  # clean hangup
+                req, _, _ = got
                 rid = req.get("id")
+                op = req.get("op")
+                if op == "hello":
+                    agreed = min(int(req.get("wire", 1)), self.wire_max)
+                    protocol.send_frame(conn, {
+                        "id": rid, "ok": True,
+                        "result": {"wire": agreed, "rank": self.rank}})
+                    wire = agreed
+                    continue
+                resp = self._execute(req, rid)
+                resp["load"] = self._load_snapshot()
+                binary = (wire == protocol.WIRE_BINARY
+                          and op in ("predict", "generate"))
                 try:
-                    result = self._handle(req)
-                    resp = {"id": rid, "ok": True, **result}
-                except BaseException as e:  # noqa: BLE001 — every op
-                    # failure becomes a structured envelope; the
-                    # router re-raises the concrete class
-                    resp = {"id": rid, "ok": False,
-                            "error": protocol.encode_error(e)}
-                try:
-                    protocol.send_frame(conn, resp)
+                    protocol.send_envelope(conn, resp, binary=binary)
                 except (TypeError, ValueError,
                         protocol.FrameError) as e:
                     # an unserializable or oversized RESULT must
@@ -199,12 +250,17 @@ class ServingWorker:
                     # Safe to send a second frame: both failures fire
                     # BEFORE any bytes hit the socket — a mid-send
                     # OSError stays fatal for exactly that reason.
+                    err = {"error": type(e).__name__,
+                           "message": f"unserializable response: {e}"}
+                    attempted = getattr(e, "attempted_bytes", None)
+                    if attempted is not None:
+                        err["attempted_bytes"] = attempted
+                        err["max_frame_bytes"] = \
+                            protocol.max_frame_bytes()
                     protocol.send_frame(conn, {
                         "id": rid, "ok": False,
-                        "error": {"error": type(e).__name__,
-                                  "message": f"unserializable "
-                                             f"response: {e}"}})
-                if req.get("op") == "shutdown":
+                        "load": self._load_snapshot(), "error": err})
+                if op == "shutdown":
                     self._stop.set()
                     return
         except (protocol.FrameError, OSError):
@@ -216,6 +272,29 @@ class ServingWorker:
                 pass
 
     # ---- ops ----
+    def _execute(self, req: Dict[str, Any],
+                 rid: Any) -> Dict[str, Any]:
+        """One op, balanced: the in-flight count rides every exit
+        explicitly (the PR 6 seat-leak discipline, zoolint ZL702 —
+        which is also why this lives OUTSIDE _serve_conn's transport
+        try: a nested protected region would hide the balance from
+        the exception-path CFG).  In-flight covers every op uniformly
+        (control ops are rare and brief) with deliberately LOCK-FREE
+        bare updates — the piggyback is a load HINT, the router's own
+        outstanding count is the scheduling truth."""
+        try:
+            self._inflight += 1
+            result = self._handle(req)
+        except BaseException as e:  # noqa: BLE001 — every op failure
+            # becomes a structured envelope; the router re-raises the
+            # concrete class
+            self._inflight -= 1
+            return {"id": rid, "ok": False,
+                    "error": protocol.encode_error(e)}
+        else:
+            self._inflight -= 1
+            return {"id": rid, "ok": True, **result}
+
     def _handle(self, req: Dict[str, Any]) -> Dict[str, Any]:
         op = req.get("op")
         if op == "predict":
@@ -225,7 +304,11 @@ class ServingWorker:
                 deadline_ms=req.get("deadline_ms"),
                 trace_id=req.get("trace_id"),
                 priority_class=req.get("priority_class"))
-            return {"result": protocol.encode_value(out), "info": info}
+            # results stay RAW arrays: send_envelope owns the encoding
+            # (binary hoists them out-of-band; JSON b64s them) — a
+            # pre-encoded __nd__ dict would ride the binary wire as
+            # base64 TEXT and throw the savings away
+            return {"result": out, "info": info}
         if op == "generate":
             prompts = protocol.decode_value(req["prompt_ids"])
             # sampling params cross the wire as json scalars; the same
@@ -241,7 +324,7 @@ class ServingWorker:
                 temperature=req.get("temperature", 0.0),
                 top_k=req.get("top_k"), top_p=req.get("top_p"),
                 seed=req.get("seed", 0))
-            return {"result": protocol.encode_value(out), "info": info}
+            return {"result": out, "info": info}
         fn = self._control.get(op)
         if fn is None:
             raise ValueError(f"unknown op {op!r}")

@@ -185,6 +185,18 @@ class ModelRegistry:
                     else None)
                 for n, e in list(self._entries.items())}
 
+    def resident_models(self) -> List[str]:
+        """Models a request would serve WITHOUT a pager fault right
+        now: active, and either unpaged (always on device) or pager
+        state ``resident``.  Lock-free snapshot reads, same discipline
+        as :meth:`models` — this is the residency the fleet worker
+        piggybacks onto every reply for the router's affinity
+        scoring, so it must cost one dict walk, never a lock."""
+        return sorted(
+            n for n, e in list(self._entries.items())
+            if e.active is not None
+            and e.pager_state in (None, "resident"))
+
     # ---- deploy / swap ----
     def deploy(self, name: str, net=None, *, jax_fn=None, params=None,
                model=None, version: Optional[int] = None,

@@ -87,7 +87,14 @@ DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        # byte of service, so a stray host sync or
                        # free-text log there stalls every caller
                        # queued on the same fault
-                       "fault_in", "_try_evict")
+                       "fault_in", "_try_evict",
+                       # fleet v2 binary wire: the out-of-band payload
+                       # encode/decode runs once per negotiated
+                       # predict/generate frame in BOTH directions —
+                       # the whole point is shaving per-hop copies, so
+                       # a stray materialization or free-text log here
+                       # pays twice per request
+                       "encode_binary", "decode_binary")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

@@ -4283,7 +4283,22 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
 
     Plus the fleet scrape: every worker's exposition merged rank-
     labeled through the pod aggregator + the zoo_fleet_* families,
-    round-tripped through the stdlib parser."""
+    round-tripped through the stdlib parser.
+
+    Fleet v2 legs (PR 16):
+
+    * **wire A/B** — the same requests over the JSON wire then the
+      negotiated binary wire: byte-identical replies, measured
+      bytes/request reduction gated;
+    * **router-path throughput** — closed-loop rate through the
+      router vs the single-process registry, floor-gated;
+    * **elastic pool** — ``set_pool_size`` up (the newcomer replays
+      the version set warm: 0 compiles), then an autoscaler-driven
+      scale-down MID-TRAFFIC: the victim drains, zero failed
+      requests, no postmortem;
+    * **residency affinity** — a pager-enabled fleet serving a
+      3x-overcommitted multi-model mix under skewed traffic:
+      affinity hit-rate and cold-fault p99 gated, all bit-exact."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import shutil
     import tempfile
@@ -4491,6 +4506,225 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
             ok = False
             _log(f"fleet FAIL: unparseable fleet scrape: {e}")
             results["scrape"] = {"error": str(e)}
+
+        # ============== fleet v2 legs (PR 16) =======================
+        import threading as _threading
+
+        from analytics_zoo_tpu.serving.fleet import fleet_autoscaler
+
+        # ---- leg C: wire A/B — bytes/request, bit-exact ------------
+        # the SAME requests ride the v1 JSON wire then the negotiated
+        # binary wire: replies must be byte-identical, and the binary
+        # frames measurably smaller (b64 alone is +33% on arrays)
+        M = 30 if quick else 60
+        xw = np.random.default_rng(5).normal(size=(8, d)).astype(
+            np.float32)
+        ref_w = np.asarray(local.predict("ref2", xw)).copy()
+
+        def measure_wire(mode):
+            router.set_wire(mode)
+            wb0 = router.wire_bytes
+            for _ in range(M):
+                out_w, _ = router.predict_ex("mlp", xw)
+                if not np.array_equal(np.asarray(out_w), ref_w):
+                    raise RuntimeError(
+                        f"wire={mode} reply not bit-exact")
+            wb1 = router.wire_bytes
+            tx = wb1.get(("tx", mode), 0) - wb0.get(("tx", mode), 0)
+            rx = wb1.get(("rx", mode), 0) - wb0.get(("rx", mode), 0)
+            return (tx + rx) / M
+
+        per_json = measure_wire("json")
+        per_bin = measure_wire("binary")
+        reduction = 1.0 - per_bin / max(per_json, 1e-9)
+        g6 = per_bin > 0 and reduction >= 0.15
+        results["wire"] = {
+            "bytes_per_request_json": round(per_json, 1),
+            "bytes_per_request_binary": round(per_bin, 1),
+            "reduction": round(reduction, 4)}
+        print("FLEET_WIRE_BINARY_" + ("OK" if g6 else "FAIL")
+              + f" json_B={per_json:.0f} binary_B={per_bin:.0f} "
+              f"reduction={reduction:.1%}", flush=True)
+        if not g6:
+            ok = False
+            _log(f"fleet FAIL: wire leg: {results['wire']}")
+
+        # ---- leg D: router-path closed-loop throughput -------------
+        # the wire hop + framing must keep a usable fraction of the
+        # single-process rate (N worker processes offset the hop); the
+        # floor is deliberately conservative — CI boxes vary
+        secs = 2.0 if quick else 4.0
+        n_threads = 8
+
+        def closed_loop(fn):
+            stop_at = time.perf_counter() + secs
+            counts = [0] * n_threads
+
+            def _worker(i):
+                while time.perf_counter() < stop_at:
+                    fn()
+                    counts[i] += 1
+
+            ts = [_threading.Thread(target=_worker, args=(i,))
+                  for i in range(n_threads)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            return sum(counts) / secs
+
+        local_qps = closed_loop(lambda: local.predict("ref2", xw))
+        fleet_qps = closed_loop(lambda: router.predict("mlp", xw))
+        ratio = fleet_qps / max(local_qps, 1e-9)
+        floor = 0.35
+        g7 = ratio >= floor
+        results["throughput"] = {
+            "single_process_qps": round(local_qps, 1),
+            "router_path_qps": round(fleet_qps, 1),
+            "ratio": round(ratio, 3), "floor": floor}
+        print("FLEET_ROUTER_THROUGHPUT_" + ("OK" if g7 else "FAIL")
+              + f" single={local_qps:.0f}qps fleet={fleet_qps:.0f}qps "
+              f"ratio={ratio:.2f} floor={floor}", flush=True)
+        if not g7:
+            ok = False
+            _log(f"fleet FAIL: throughput leg: {results['throughput']}")
+
+        # ---- leg E: elastic pool — warm scale-up, drained down -----
+        n0 = cfg["n_workers"]
+        rep_up = router.set_pool_size(n0 + 1)
+        new_rank = rep_up["grew"][0] if rep_up["grew"] else None
+        replay_up = router.replays.get(new_rank, [])
+        up_compiles = sum(r.get("compiles", 0) for r in replay_up)
+        g8 = (bool(rep_up["grew"]) and up_compiles == 0
+              and [(r["model"], r["version"]) for r in replay_up]
+              == [("mlp", 2)]
+              and router.pool_size() == n0 + 1)
+        results["scale_up"] = {"grew": rep_up["grew"],
+                               "replay": replay_up,
+                               "replay_compiles": up_compiles}
+        print("FLEET_SCALE_UP_" + ("OK" if g8 else "FAIL")
+              + f" grew={rep_up['grew']} "
+              f"replay_compiles={up_compiles}", flush=True)
+        if not g8:
+            ok = False
+            _log(f"fleet FAIL: scale-up leg: {results['scale_up']}")
+
+        # autoscaler-driven scale-down MID-TRAFFIC: the victim drains
+        # (zero failed requests), retires without a postmortem
+        pm_before2 = len(router.supervisor.postmortems)
+
+        def autoscale_down():
+            sc = fleet_autoscaler(
+                router, min_replicas=n0, max_replicas=n0 + 1,
+                up_queue_depth=1e9, down_queue_depth=1e9,
+                hold_ticks=1, cooldown_s=0.0, interval_s=0.05)
+            deadline2 = time.monotonic() + 30
+            while time.monotonic() < deadline2:
+                evd = sc.tick()
+                if evd is not None:
+                    return evd
+                time.sleep(0.05)
+            raise RuntimeError("autoscaler never scaled down")
+
+        outcomes_s, _, ev_s = _fleet_traffic(
+            router, "mlp", x, refs, cfg["rate_hz"], cfg["duration_s"],
+            autoscale_down, cfg["event_at_s"])
+        failed_s = sum(outcomes_s.get(o, 0)
+                       for o in ("error", "shed", "deadline"))
+        g9 = ("error" not in ev_s and failed_s == 0
+              and router.pool_size() == n0
+              and len(router.supervisor.postmortems) == pm_before2)
+        results["scale_down"] = {
+            "outcomes": outcomes_s, "failed": failed_s,
+            "event": ev_s.get("result"),
+            "event_error": ev_s.get("error"),
+            "pool_after": router.pool_size(),
+            "new_postmortems": (len(router.supervisor.postmortems)
+                                - pm_before2)}
+        print("FLEET_SCALE_DOWN_" + ("OK" if g9 else "FAIL")
+              + f" failed={failed_s} pool={router.pool_size()} "
+              f"requests={sum(outcomes_s.values())}", flush=True)
+        if not g9:
+            ok = False
+            _log(f"fleet FAIL: scale-down leg: "
+                 f"{results['scale_down']}")
+
+        # ---- leg F: residency affinity, 3x-overcommitted mix -------
+        # a FRESH pager-enabled fleet (resident budget per worker),
+        # serving 3x more models than fit on-device fleet-wide: the
+        # residency-weighted scheduler must keep the hit-rate up and
+        # the cold-fault tail bounded, every reply bit-exact
+        router.close()
+        router = None
+        n_aff, budget = 2, 2
+        n_models = 3 * n_aff * budget
+        reg_aff = dict(cfg["registry"])
+        reg_aff["pager"] = {"max_resident": budget}
+        router = FleetRouter(
+            os.path.join(work, "share"), n_workers=n_aff,
+            registry_kwargs=reg_aff, env=worker_env,
+            max_restarts=2, restart_backoff=0.3)
+        _log(f"fleet: starting {n_aff} pager workers "
+             f"(budget {budget}, {n_models} models)")
+        router.start(timeout=300)
+        models = [f"aff{i}" for i in range(n_models)]
+        aff_refs = {}
+        for i, m in enumerate(models):
+            p = make_params(100 + i)
+            rep_a = router.deploy(m, p, builder_path,
+                                  builder_args={"n_layers": n_layers},
+                                  warmup_shapes=[d])
+            errs_a = [a for a in rep_a["activations"] if "error" in a]
+            if errs_a:
+                raise RuntimeError(f"affinity deploy {m}: {errs_a}")
+            kw_a = _mlp({"n_layers": n_layers}, p)
+            local.deploy(m, jax_fn=kw_a["jax_fn"],
+                         params=kw_a["params"], warmup_shapes=(d,))
+            aff_refs[m] = np.asarray(local.predict(m, x)).copy()
+        rng_aff = np.random.default_rng(9)
+        n_aff_reqs = 120 if quick else 240
+        lat_ms = []
+        failed_aff = 0
+        aff0 = router.affinity_counts
+        for _ in range(n_aff_reqs):
+            # skewed mix: 75% of traffic on one hot model per worker,
+            # the tail spread over the other 3x models
+            if rng_aff.random() < 0.75:
+                m = models[int(rng_aff.integers(n_aff))]
+            else:
+                m = models[int(n_aff + rng_aff.integers(
+                    n_models - n_aff))]
+            t1 = time.perf_counter()
+            try:
+                out_a, _ = router.predict_ex(m, x)
+            except Exception:  # noqa: BLE001 — counted, gated
+                failed_aff += 1
+                continue
+            lat_ms.append((time.perf_counter() - t1) * 1e3)
+            if not np.array_equal(np.asarray(out_a), aff_refs[m]):
+                raise RuntimeError(
+                    f"affinity mix not bit-exact for {m}")
+        aff1 = router.affinity_counts
+        hits = aff1["hit"] - aff0["hit"]
+        misses = aff1["miss"] - aff0["miss"]
+        colds = aff1["cold"] - aff0["cold"]
+        total_aff = max(hits + misses + colds, 1)
+        hit_rate = hits / total_aff
+        p99_ms = float(np.percentile(np.asarray(lat_ms), 99.0))
+        p99_bound = 2000.0
+        g10 = (failed_aff == 0 and hit_rate >= 0.5
+               and p99_ms < p99_bound)
+        results["affinity"] = {
+            "workers": n_aff, "budget": budget, "models": n_models,
+            "requests": n_aff_reqs, "failed": failed_aff,
+            "hit": hits, "miss": misses, "cold": colds,
+            "hit_rate": round(hit_rate, 4),
+            "p99_ms": round(p99_ms, 2), "p99_bound_ms": p99_bound}
+        print("FLEET_AFFINITY_" + ("OK" if g10 else "FAIL")
+              + f" hit_rate={hit_rate:.2f} hit={hits} miss={misses} "
+              f"cold={colds} p99_ms={p99_ms:.0f} "
+              f"failed={failed_aff}", flush=True)
+        if not g10:
+            ok = False
+            _log(f"fleet FAIL: affinity leg: {results['affinity']}")
     except (RuntimeError, OSError, KeyError, ValueError,
             subprocess.TimeoutExpired, json.JSONDecodeError) as e:
         _log(f"fleet FAIL: {type(e).__name__}: {e}")

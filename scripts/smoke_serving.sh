@@ -160,7 +160,14 @@ grep -q "DENSITY_SELFCHECK_OK" <<<"$dn" || {
 # requests in both legs, only the FIRST activation of each version
 # compiles (every later worker and the restarted one warm from the
 # store with 0), outputs bit-identical to a single-process registry,
-# and the rank-merged fleet scrape parser-clean.
+# and the rank-merged fleet scrape parser-clean.  Fleet v2 adds four
+# gated legs to the same run: the negotiated binary wire (bit-exact
+# A/B vs JSON with a measured bytes/request reduction), the
+# router-path throughput floor, the elastic pool (warm zero-compile
+# scale-up, then an autoscaler-driven scale-down mid-traffic that
+# drains the victim with zero failed requests), and residency-aware
+# routing over a 3x-overcommitted pager fleet (affinity hit-rate +
+# bounded cold-fault p99, bit-exact).
 fl=$(timeout -k 10 590 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
     XLA_FLAGS="--xla_force_host_platform_device_count=2" \
     python bench.py fleet --quick --selfcheck)
@@ -172,6 +179,21 @@ grep -Eq "FLEET_ROLLING_UPGRADE_OK .*failed=0" <<<"$fl" || {
 grep -Eq "FLEET_WORKER_KILL_OK .*failed=0 .*replay_compiles=0" <<<"$fl" || {
     echo "smoke FAIL: fleet worker-kill leg dropped requests or the" \
          "restarted worker did not warm zero-compile from the store" >&2
+    exit 1
+}
+grep -Eq "FLEET_WIRE_BINARY_OK .*reduction=" <<<"$fl" || {
+    echo "smoke FAIL: fleet binary-wire A/B missing, not bit-exact," \
+         "or no measured byte reduction" >&2
+    exit 1
+}
+grep -Eq "FLEET_AFFINITY_OK .*failed=0" <<<"$fl" || {
+    echo "smoke FAIL: residency-affinity leg missing, hit-rate/p99" \
+         "out of bounds, or requests failed" >&2
+    exit 1
+}
+grep -Eq "FLEET_SCALE_DOWN_OK failed=0" <<<"$fl" || {
+    echo "smoke FAIL: elastic scale-down dropped requests or the" \
+         "autoscaler never drove the pool" >&2
     exit 1
 }
 grep -q "FLEET_SELFCHECK_OK" <<<"$fl" || {

@@ -12,6 +12,7 @@ import json
 import os
 import socket
 import struct
+import threading
 import time
 import zlib
 
@@ -119,6 +120,131 @@ def test_crc_mismatch_and_oversize_raise():
         b.close()
 
 
+# --------------------------------------------------- binary wire (v2)
+def test_binary_payload_roundtrip_zero_copy():
+    """The v2 binary payload: nested envelopes with arrays hoisted
+    out-of-band round-trip bit-exactly (NaN and -0.0 payload bits
+    included), and the decode side is ZERO-copy — every array comes
+    back as a read-only view over the received buffer."""
+    ints = np.arange(10, dtype=np.int16).reshape(2, 5)
+    x = ints.astype(np.float32)
+    x[0, 0] = np.nan
+    x[1, 1] = -0.0
+    obj = {"op": "predict", "id": 9, "inputs": x,
+           "nested": {"deep": [ints, {"k": x}], "s": "txt", "n": None},
+           "empty": np.zeros((0, 3), dtype=np.float64)}
+    payload = protocol.encode_binary(obj)
+    assert payload.startswith(protocol.BIN_MAGIC)
+    back = protocol.decode_binary(payload)
+    assert back["op"] == "predict" and back["id"] == 9
+    y = back["inputs"]
+    assert y.dtype == np.float32 and y.shape == (2, 5)
+    assert y.tobytes() == x.tobytes()  # NaN/-0.0 bits survive
+    assert back["nested"]["deep"][0].dtype == np.int16
+    assert np.array_equal(back["nested"]["deep"][0], ints)
+    assert back["nested"]["s"] == "txt" and back["nested"]["n"] is None
+    assert back["empty"].shape == (0, 3)
+    # zero-copy: views over the payload buffer, not owned copies
+    assert y.base is not None and not y.flags.writeable
+    # and the whole point: binary beats the b64 JSON encoding on size
+    as_json = json.dumps(protocol.encode_value(obj),
+                         separators=(",", ":")).encode()
+    assert len(payload) < len(as_json)
+
+
+def test_binary_envelope_over_socket_first_byte_discriminates():
+    """recv_envelope reads EITHER encoding on the same connection with
+    no negotiation (0xff can never begin a JSON text) and reports the
+    frame's encoding + wire bytes — the byte-accounting feed."""
+    a, b = _pair()
+    try:
+        x = np.arange(24, dtype=np.float64).reshape(4, 6)
+        n_tx = protocol.send_envelope(a, {"id": 1, "inputs": x},
+                                      binary=True)
+        env, n_rx, enc = protocol.recv_envelope(b)
+        assert enc == "binary" and n_rx == n_tx
+        assert np.array_equal(env["inputs"], x)
+        # same socket, JSON frame next — arrays still materialize
+        n_tx = protocol.send_envelope(a, {"id": 2, "inputs": x},
+                                      binary=False)
+        env, n_rx, enc = protocol.recv_envelope(b)
+        assert enc == "json" and n_rx == n_tx
+        assert np.array_equal(env["inputs"], x)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_binary_torn_mid_buffer_and_crc_raise():
+    """A worker SIGKILLed mid-sendall of a binary frame leaves a torn
+    frame; a flipped bit in the raw buffer region is a CRC conviction
+    — both are FrameError, never a short array parsed as truth."""
+    payload = protocol.encode_binary(
+        {"id": 4, "x": np.arange(1024, dtype=np.float64)})
+    frame = struct.pack("<II", len(payload),
+                        zlib.crc32(payload) & 0xffffffff) + payload
+    a, b = _pair()
+    a.sendall(frame[:len(frame) - 100])  # torn inside the buffer
+    a.close()
+    try:
+        with pytest.raises(protocol.FrameError, match="short read"):
+            protocol.recv_envelope(b)
+    finally:
+        b.close()
+    a, b = _pair()
+    a.sendall(frame[:-1] + bytes([frame[-1] ^ 0xFF]))
+    try:
+        with pytest.raises(protocol.FrameError, match="CRC"):
+            protocol.recv_envelope(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_binary_garbage_header_is_frame_error():
+    bad = protocol.BIN_MAGIC + struct.pack("<I", 999999) + b"{}"
+    with pytest.raises(protocol.FrameError, match="binary"):
+        protocol.decode_binary(bad)
+
+
+def test_env_frame_cap_and_attempted_bytes(monkeypatch):
+    """ZOO_FLEET_MAX_FRAME caps both directions; the oversize-SEND
+    flavor carries attempted_bytes and fires before any bytes hit the
+    socket, so the connection survives (the worker's degrade-to-error
+    path depends on exactly this)."""
+    monkeypatch.setenv("ZOO_FLEET_MAX_FRAME", "64")
+    assert protocol.max_frame_bytes() == 64
+    a, b = _pair()
+    try:
+        with pytest.raises(protocol.FrameError) as ei:
+            protocol.send_envelope(
+                a, {"id": 1, "x": np.zeros(64)}, binary=True)
+        assert ei.value.attempted_bytes is not None
+        assert ei.value.attempted_bytes > 64
+        with pytest.raises(protocol.FrameError) as ei:
+            protocol.send_frame(a, {"id": 1, "pad": "y" * 64})
+        assert ei.value.attempted_bytes is not None
+        # no bytes ever hit the socket: it still carries frames once
+        # the cap allows them
+        monkeypatch.setenv("ZOO_FLEET_MAX_FRAME", "1048576")
+        protocol.send_envelope(a, {"id": 2}, binary=False)
+        assert protocol.recv_envelope(b)[0] == {"id": 2}
+    finally:
+        a.close()
+        b.close()
+    # receive side: an oversized length prefix is convicted BEFORE
+    # allocating the claimed payload
+    monkeypatch.setenv("ZOO_FLEET_MAX_FRAME", "64")
+    a, b = _pair()
+    a.sendall(struct.pack("<II", 100, 0))
+    try:
+        with pytest.raises(protocol.FrameError, match="exceeds"):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
 @pytest.mark.parametrize("exc,code,detail", [
     (Overloaded("queue full", evicted=True, queue_depth=64),
      "Overloaded", ("evicted", True)),
@@ -176,12 +302,14 @@ def test_artifact_commit_point_is_the_spec(tmp_path):
 def make_fleet(tmp_path):
     routers = []
 
-    def make(n_workers=2, registry_kwargs=None, **kw):
+    def make(n_workers=2, registry_kwargs=None, env=None, **kw):
         kw.setdefault("max_restarts", 2)
         kw.setdefault("restart_backoff", 0.2)
+        worker_env = {"PYTHONPATH": REPO}
+        worker_env.update(env or {})
         r = FleetRouter(str(tmp_path / "share"), n_workers=n_workers,
                         fake=True, registry_kwargs=registry_kwargs,
-                        env={"PYTHONPATH": REPO}, **kw)
+                        env=worker_env, **kw)
         r.start(timeout=60)
         routers.append(r)
         return r
@@ -415,6 +543,207 @@ def test_least_outstanding_spreads_and_ping(make_fleet):
                      (("model", "m"), ("rank", str(rk)),
                       ("version", "1")))) for rk in (0, 1)]
     assert all(c and c >= 3 for c in counts), counts
+
+
+# ----------------------------------------------- fleet v2 (fake mode)
+def test_binary_wire_shrinks_bytes_and_stays_bit_exact(make_fleet):
+    """The negotiated binary wire vs the JSON wire, A/B on one fleet:
+    identical results bit-for-bit, measurably fewer bytes on both
+    directions (b64 alone is +33%), counted per (direction, encoding)
+    — and the worker's load piggyback populates the router's residency
+    view on the data path."""
+    r = make_fleet(n_workers=1)
+    r.deploy("m", None, STUB, builder_args={"scale": 3.0})
+    x = np.arange(64 * 64, dtype=np.float64).reshape(64, 64) / 7.0
+    wb0 = r.wire_bytes
+    out_bin, _ = r.predict_ex("m", x)
+    wb1 = r.wire_bytes
+    bin_tx = wb1.get(("tx", "binary"), 0) - wb0.get(("tx", "binary"), 0)
+    bin_rx = wb1.get(("rx", "binary"), 0) - wb0.get(("rx", "binary"), 0)
+    assert bin_tx > 0 and bin_rx > 0
+    # the reply's piggyback refreshed residency lock-free
+    assert "m" in r.handles[0].resident
+    r.set_wire("json")
+    out_json, _ = r.predict_ex("m", x)
+    wb2 = r.wire_bytes
+    json_tx = wb2.get(("tx", "json"), 0) - wb1.get(("tx", "json"), 0)
+    json_rx = wb2.get(("rx", "json"), 0) - wb1.get(("rx", "json"), 0)
+    assert np.array_equal(out_bin, x * 3.0)
+    assert np.asarray(out_bin).tobytes() == np.asarray(out_json).tobytes()
+    # same request, same reply: the binary frames are >20% smaller
+    assert json_tx > bin_tx * 1.2, (json_tx, bin_tx)
+    assert json_rx > bin_rx * 1.2, (json_rx, bin_rx)
+
+
+def test_wire_negotiation_falls_back_to_json_pinned_worker(make_fleet):
+    """ZOO_FLEET_WIRE=json pins the worker's negotiated ceiling to v1:
+    the router's hello lands on the pinned worker, the connection
+    stays on JSON, traffic still serves bit-exactly, and every frame
+    is accounted under encoding=json — mixed fleets interoperate."""
+    r = make_fleet(n_workers=1, env={"ZOO_FLEET_WIRE": "json"})
+    r.deploy("m", None, STUB, builder_args={"scale": 2.0})
+    x = np.arange(32, dtype=np.float64).reshape(4, 8)
+    out, _ = r.predict_ex("m", x)
+    assert np.array_equal(out, x * 2.0)
+    wb = r.wire_bytes
+    assert wb[("tx", "json")] > 0 and wb[("rx", "json")] > 0
+    assert not any(enc == "binary" for _, enc in wb)
+
+
+def test_affinity_scoring_prefers_resident_worker(make_fleet):
+    """Residency-weighted scheduling: a worker holding the model wins
+    until it is ``affinity_penalty`` requests deeper than a sibling
+    (soft pin — load can override), outcomes counted hit/miss/cold
+    and exposed as zoo_fleet_affinity_total."""
+    r = make_fleet(n_workers=2)  # default affinity_penalty=4
+    h0, h1 = r.handles
+    h1.resident = frozenset({"m"})
+    # the resident worker wins while its load gap stays under the
+    # penalty: 4 consecutive picks, no releases, all hits
+    for _ in range(4):
+        assert r._pick(model="m") is h1
+    # at outstanding=4 the non-resident sibling ties (0 + penalty)
+    # and the rotation sends the overflow there: a counted miss
+    assert r._pick(model="m") is h0
+    # nobody holds this one: somebody must fault it — cold
+    r._pick(model="other")
+    assert r.affinity_counts == {"hit": 4, "miss": 1, "cold": 1}
+    # the retry re-pick is count=False: one request, one outcome
+    r._pick(model="m", count=False)
+    assert r.affinity_counts == {"hit": 4, "miss": 1, "cold": 1}
+    fams = {f.name: f for f in r.families()}
+    aff = {s[0]["outcome"]: s[1]
+           for s in fams["zoo_fleet_affinity_total"].samples}
+    assert aff == {"hit": 4, "miss": 1, "cold": 1}
+    assert "zoo_fleet_wire_bytes_total" in fams
+
+
+def test_router_coalesces_concurrent_predicts(make_fleet):
+    """Cross-process coalescing: concurrent compatible predicts merge
+    into ONE wire request (leader concatenates, serves, splits), each
+    caller gets its own rows bit-exactly, and the merged ride is
+    visible in info["coalesced"]."""
+    r = make_fleet(n_workers=1, coalesce_ms=40.0)
+    r.deploy("m", None, STUB, builder_args={"scale": 2.0})
+    xs = [np.full((2, 4), float(i)) for i in range(3)]
+    outs = [None] * 3
+    infos = [None] * 3
+    errs = []
+
+    def call(i):
+        try:
+            outs[i], infos[i] = r.predict_ex("m", xs[i])
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+        time.sleep(0.005)  # land inside the leader's window
+    for t in threads:
+        t.join()
+    assert errs == []
+    for i in range(3):
+        assert np.array_equal(outs[i], xs[i] * 2.0), i
+    # at least the riders saw the merged batch
+    merged = [inf.get("coalesced") for inf in infos
+              if inf.get("coalesced")]
+    assert merged and max(merged) >= 4  # >= leader rows + one rider
+
+
+def test_elastic_scale_down_drains_then_scale_up_revives(make_fleet):
+    """The elastic pool round trip under live traffic: scale-down
+    latches + drains the victims (zero dropped requests, zero
+    postmortems — deliberate retirement, not an incident), scale-up
+    revives the retired slots as fresh incarnations that replay the
+    version set warm before turning routable."""
+    r = make_fleet(n_workers=3)
+    r.deploy("m", None, STUB,
+             builder_args={"scale": 2.0, "delay_s": 0.05})
+    x = np.ones((1, 4))
+    oks, errs = [], []
+
+    def hammer():
+        for _ in range(10):
+            try:
+                out, _ = r.predict_ex("m", x)
+                oks.append(bool(np.array_equal(out, x * 2.0)))
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)  # traffic in flight when the shrink lands
+    rep = r.set_pool_size(1)
+    for t in threads:
+        t.join()
+    assert errs == [] and all(oks) and len(oks) == 40
+    assert rep["retired"] == [2, 1] and rep["forced"] == []
+    assert r.pool_size() == 1
+    assert r.states()["retired"] == 2
+    assert r.supervisor.postmortems == []
+    # grow back: retired slots revive first, warm from replay
+    rep2 = r.set_pool_size(3)
+    assert sorted(rep2["grew"]) == [1, 2]
+    assert _wait(lambda: r.states().get("live") == 3)
+    for rk in (1, 2):
+        info = r.ping(rk)
+        assert info["incarnation"] == 1  # a revival, not a restart
+        assert info["models"] == {"m": 1}
+        assert [rec["model"] for rec in r.replays[rk]] == ["m"]
+    out, _ = r.predict_ex("m", x)
+    assert np.array_equal(out, x * 2.0)
+
+
+def test_autoscaler_drives_pool_through_load_signals(make_fleet):
+    """fleet_autoscaler wires PR 6's Autoscaler to the router: the
+    queue-depth signal crosses via load_signals() and apply_scale
+    resizes the pool through set_pool_size — ticked synthetically
+    (the bench drives it with real traffic)."""
+    from analytics_zoo_tpu.serving.fleet import fleet_autoscaler
+    r = make_fleet(n_workers=2)
+    r.deploy("m", None, STUB)
+    r.set_pool_size(1)
+    sc = fleet_autoscaler(
+        r, min_replicas=1, max_replicas=2, up_queue_depth=2,
+        down_queue_depth=0, hold_ticks=1, cooldown_s=0.0,
+        interval_s=0.01)
+    assert r.pool_size() == 1
+    # synthetic pressure: park router-side outstanding above the bar
+    with r._lock:
+        r.handles[0].outstanding += 3
+    sc.tick()
+    assert r.pool_size() == 2
+    with r._lock:
+        r.handles[0].outstanding -= 3
+    out, _ = r.predict_ex("m", np.ones((1, 2)))
+    assert np.array_equal(out, np.ones((1, 2)))
+
+
+def test_oversize_reply_degrades_to_structured_error(make_fleet):
+    """A reply past ZOO_FLEET_MAX_FRAME degrades worker-side to a
+    structured error envelope carrying the attempted size — the
+    router's caller gets a ServingError with details, NOT a dead
+    connection read as a worker crash (which would retry the same
+    oversize reply into a sibling)."""
+    r = make_fleet(n_workers=1, env={"ZOO_FLEET_MAX_FRAME": "8192"})
+    # expand=64 inflates the REPLY 64x past the cap while the request
+    # stays tiny; "ok" proves the connection survives the degrade
+    r.deploy("big", None, STUB, builder_args={"expand": 64})
+    r.deploy("ok", None, STUB, builder_args={"scale": 2.0})
+    x = np.ones((4, 16), dtype=np.float64)
+    with pytest.raises(ServingError) as ei:
+        r.predict_ex("big", x)
+    d = ei.value.details
+    assert d["error"] == "FrameError"
+    assert d["attempted_bytes"] > 8192
+    assert d["max_frame_bytes"] == 8192
+    out, _ = r.predict_ex("ok", x)
+    assert np.array_equal(out, x * 2.0)
+    assert r.retries_total == 0
+    assert r.supervisor.postmortems == []
 
 
 # ------------------------------------- cross-process determinism (v2)
