@@ -98,6 +98,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...models.generation import (_decode_step, _decode_window,
                                   _embed_token, _head_logits, _prefill,
@@ -326,6 +327,7 @@ class DecodeEngine:
                  step_fuse: int = 4, prefix_pool: int = 0,
                  draft_params=None, draft_hyper: Optional[Dict] = None,
                  spec_tokens: int = 4, device=None,
+                 mesh: Optional[dict] = None,
                  store_tag: Optional[str] = None):
         # per-model accounting tag for execstore entries (stat
         # --by-model); metadata only, never part of the fingerprint
@@ -376,7 +378,51 @@ class DecodeEngine:
                 f"must leave room to decode (max_len {self.max_len})")
         self.eos_id = eos_id
         self._device = device or jax.local_devices()[0]
-        self._params = jax.device_put(params, self._device)
+        # ---- mesh-sharded slot state (big-LM continuous batching):
+        # the CAPACITY axis shards over the group's mesh, so each
+        # device steps its own contiguous slice of the slots while the
+        # per-slot decode math — attention over that slot's own cache
+        # line, sampling from that slot's own logits — stays entirely
+        # on one device.  No cross-slot term exists in the step, so
+        # the partitioned program is a pure per-device map: bit-exact
+        # vs the unsharded engine BY CONSTRUCTION (bench.py sharded
+        # gates it).  Params replicate across the group (the weights
+        # ride the forward unsharded; rule-sharded decode weights
+        # would put collectives inside the step — a later engine
+        # version's trade).
+        self._mesh_spec = None
+        self._mesh = None
+        self._mesh_cfg = None
+        if mesh is not None:
+            from ...serving.shardgroup import (carve_groups,
+                                               mesh_spec_canonical,
+                                               normalize_mesh_spec)
+            if device is not None:
+                raise ValueError(
+                    "pass mesh= or device=, not both — the mesh spec "
+                    "carves the engine's device group itself")
+            if prefix_pool or draft_params is not None:
+                raise ValueError(
+                    "mesh-sharded decode does not support prefix_pool "
+                    "or speculative drafts in this engine version — "
+                    "their pool/draft caches would need the same slot "
+                    "sharding twin")
+            spec = normalize_mesh_spec(mesh)
+            gdevs, gmesh = carve_groups(jax.local_devices(), spec)[0]
+            if self.capacity % len(gdevs):
+                raise ValueError(
+                    f"capacity ({self.capacity}) must divide evenly "
+                    f"over the mesh's {len(gdevs)} devices")
+            self._mesh_spec = spec
+            self._mesh_cfg = mesh_spec_canonical(spec)
+            self._mesh = gmesh
+            self._device = gdevs[0]
+        # device_put target for replicated inputs (params, admission
+        # scalars, prompts): the bare device unsharded, the group-
+        # replicated NamedSharding under a mesh
+        self._rep = (self._device if self._mesh is None
+                     else NamedSharding(self._mesh, P()))
+        self._params = jax.device_put(params, self._rep)
         self._n_layers = int(hyper["n_layers"])
         self.spec_tokens = int(spec_tokens)
         self._draft_hyper = (None if draft_hyper is None
@@ -388,7 +434,7 @@ class DecodeEngine:
                     f"({self._draft_hyper['max_len']}) is shorter than "
                     f"the engine's max_len ({self.max_len})")
             self._draft_params = jax.device_put(draft_params,
-                                                self._device)
+                                                self._rep)
         else:
             self._draft_params = None
 
@@ -432,11 +478,11 @@ class DecodeEngine:
         # so an uncommitted first call would cost every admit plan a
         # SECOND compile the first time it sees steady-state inputs,
         # breaking the one-compile-per-(bucket, capacity) invariant
-        self._caches = jax.device_put(caches, self._device)
-        self._dcaches = jax.device_put(dcaches, self._device)
-        self._tok = jax.device_put(tok, self._device)
-        self._pos = jax.device_put(pos, self._device)
-        self._samp = jax.device_put(samp, self._device)
+        self._caches = jax.device_put(caches, self._slot_sharding(4))
+        self._dcaches = jax.device_put(dcaches, self._slot_sharding(4))
+        self._tok = jax.device_put(tok, self._slot_sharding(1))
+        self._pos = jax.device_put(pos, self._slot_sharding(1))
+        self._samp = jax.device_put(samp, self._slot_sharding(1))
 
         # one AOT-compiled single-step plan plus a halving ladder of
         # fused window plans (step_fuse, step_fuse/2, ... 2) per
@@ -519,6 +565,26 @@ class DecodeEngine:
             target=self._decode_loop, name="zoo-decode-dispatch",
             daemon=True)
 
+    # ---- placement shardings --------------------------------------------
+    def _rep_sharding(self):
+        """Spec sharding for group-replicated inputs (scalars,
+        prompts, prefix blocks): the engine's single device unsharded,
+        the whole group under a mesh."""
+        if self._mesh is not None:
+            return NamedSharding(self._mesh, P())
+        return jax.sharding.SingleDeviceSharding(self._device)
+
+    def _slot_sharding(self, rank: int):
+        """Sharding for slot-state arrays (leading axis == capacity):
+        under a mesh the slot axis shards over EVERY mesh axis (the
+        sub-mesh exists to split the slots), remaining dims
+        replicated."""
+        if self._mesh is None:
+            return jax.sharding.SingleDeviceSharding(self._device)
+        axes = tuple(self._mesh.axis_names)
+        return NamedSharding(self._mesh,
+                             P(axes, *([None] * (rank - 1))))
+
     def _ensure_started(self):
         with self._start_cond:
             while self._warming:  # let an in-flight warmup finish
@@ -579,7 +645,7 @@ class DecodeEngine:
         return self._step_core(caches, tok, pos, samp)
 
     def _samp_specs(self):
-        s0 = jax.sharding.SingleDeviceSharding(self._device)
+        s0 = self._slot_sharding(1)
         ispec = jax.ShapeDtypeStruct((self.capacity,), jnp.int32,
                                      sharding=s0)
         fspec = jax.ShapeDtypeStruct((self.capacity,), jnp.float32,
@@ -588,7 +654,7 @@ class DecodeEngine:
 
     def _scalar_specs(self):
         """(seed, temperature, top_k, top_p) admission scalars."""
-        s0 = jax.sharding.SingleDeviceSharding(self._device)
+        s0 = self._rep_sharding()
         i0 = jax.ShapeDtypeStruct((), jnp.int32, sharding=s0)
         f0 = jax.ShapeDtypeStruct((), jnp.float32, sharding=s0)
         return (i0, f0, i0, f0)
@@ -599,7 +665,7 @@ class DecodeEngine:
         one plan signature)."""
         if self._draft_hyper is None:
             return []
-        s0 = jax.sharding.SingleDeviceSharding(self._device)
+        s0 = self._slot_sharding(4)
         dh = self._draft_hyper
         dspec = jax.ShapeDtypeStruct(
             (self.capacity, int(dh["n_heads"]), self.max_len,
@@ -610,15 +676,15 @@ class DecodeEngine:
     def _state_specs(self):
         """ShapeDtypeStructs matching the persistent decode state —
         the AOT lowering inputs for the step/admit plans (committed to
-        the engine's device, exactly like the live state)."""
-        s0 = jax.sharding.SingleDeviceSharding(self._device)
+        the engine's device — or slot-sharded over its mesh — exactly
+        like the live state)."""
         d_head = (int(self._hyper["d_model"])
                   // int(self._hyper["n_heads"]))
         cspec = jax.ShapeDtypeStruct(
             (self.capacity, int(self._hyper["n_heads"]), self.max_len,
-             d_head), jnp.float32, sharding=s0)
+             d_head), jnp.float32, sharding=self._slot_sharding(4))
         ispec = jax.ShapeDtypeStruct((self.capacity,), jnp.int32,
-                                     sharding=s0)
+                                     sharding=self._slot_sharding(1))
         caches = [(cspec, cspec) for _ in range(self._n_layers)]
         return caches, ispec, ispec, self._samp_specs()
 
@@ -641,6 +707,7 @@ class DecodeEngine:
             fp = store.fingerprint(
                 "decode-plan", name, es.hlo_digest(lowered),
                 self._wdigest, self._ddigest, self._samp_cfg,
+                self._mesh_cfg,
                 (self.capacity, self.max_len),
                 device=self._device)
             ent = store.lookup(fp)
@@ -656,6 +723,10 @@ class DecodeEngine:
                 meta = {"kind": "decode-plan", "name": name,
                         "capacity": self.capacity,
                         "max_len": self.max_len}
+                if self._mesh_spec is not None:
+                    meta["mesh"] = {
+                        "axes": dict(self._mesh_spec["axes"]),
+                        "strategy": self._mesh_spec["strategy"]}
                 if self._store_tag is not None:
                     meta["model"] = self._store_tag
                 store.put(fp, _execstore().serialize_compiled(compiled),
@@ -872,7 +943,7 @@ class DecodeEngine:
         fn = self._admit_fns.get(s_b)
         if fn is None:
             caches, tok, pos, samp = self._state_specs()
-            s0 = jax.sharding.SingleDeviceSharding(self._device)
+            s0 = self._rep_sharding()
             pspec = jax.ShapeDtypeStruct((1, s_b), jnp.int32,
                                          sharding=s0)
             sspec = jax.ShapeDtypeStruct((), jnp.int32, sharding=s0)
@@ -909,7 +980,7 @@ class DecodeEngine:
     def _pfxfill_fn_for(self, p_b: int):
         fn = self._pfxfill_fns.get(p_b)
         if fn is None:
-            s0 = jax.sharding.SingleDeviceSharding(self._device)
+            s0 = self._rep_sharding()
             pspec = jax.ShapeDtypeStruct((1, p_b), jnp.int32,
                                          sharding=s0)
             fn = self._pfxfill_fns[p_b] = self._plan(
@@ -918,7 +989,7 @@ class DecodeEngine:
         return fn
 
     def _pfx_block_specs(self, p_b: int):
-        s0 = jax.sharding.SingleDeviceSharding(self._device)
+        s0 = self._rep_sharding()
         h = self._hyper
         d_head = int(h["d_model"]) // int(h["n_heads"])
         bspec = jax.ShapeDtypeStruct(
@@ -980,7 +1051,7 @@ class DecodeEngine:
         fn = self._pfxadmit_fns.get((p_b, s_b))
         if fn is None:
             caches, tok, pos, samp = self._state_specs()
-            s0 = jax.sharding.SingleDeviceSharding(self._device)
+            s0 = self._rep_sharding()
             blocks, hspec = self._pfx_block_specs(p_b)
             tspec = jax.ShapeDtypeStruct((1, s_b - p_b), jnp.int32,
                                          sharding=s0)
@@ -1012,13 +1083,13 @@ class DecodeEngine:
                     "once it is serving")
             self._warming = True
         try:
-            zero = jax.device_put(np.int32(0), self._device)
-            one = jax.device_put(np.int32(1), self._device)
-            fzero = jax.device_put(np.float32(0.0), self._device)
-            fone = jax.device_put(np.float32(1.0), self._device)
+            zero = jax.device_put(np.int32(0), self._rep)
+            one = jax.device_put(np.int32(1), self._rep)
+            fzero = jax.device_put(np.float32(0.0), self._rep)
+            fone = jax.device_put(np.float32(1.0), self._rep)
             for b in self.prompt_buckets:
                 prompt = jax.device_put(np.zeros((1, b), np.int32),
-                                        self._device)
+                                        self._rep)
                 # tb covers the plan BUILD (the AOT compile — or the
                 # store load that replaces it) plus one verifying
                 # execution; compile_time_s is honest either way
@@ -1265,6 +1336,10 @@ class DecodeEngine:
         out["prefix_pool_entries"] = (len(pool.entries)
                                       if pool is not None else 0)
         out["spec_enabled"] = self._draft_hyper is not None
+        if self._mesh_spec is not None:
+            out["mesh_axes"] = dict(self._mesh_spec["axes"])
+            out["mesh_devices"] = int(np.prod(
+                list(self._mesh_spec["axes"].values())))
         proposed = out.get("spec_proposed", 0)
         out["spec_acceptance"] = (
             round(out.get("spec_accepted", 0) / proposed, 4)
@@ -1304,13 +1379,13 @@ class DecodeEngine:
         explicit device_put like every other host->device hop in the
         loop (a bare python float into a jit is an implicit transfer
         of its own)."""
-        return (jax.device_put(np.int32(req.seed), self._device),
+        return (jax.device_put(np.int32(req.seed), self._rep),
                 jax.device_put(np.float32(req.temperature),
-                               self._device),
-                jax.device_put(np.int32(req.top_k or 0), self._device),
+                               self._rep),
+                jax.device_put(np.int32(req.top_k or 0), self._rep),
                 jax.device_put(np.float32(1.0 if req.top_p is None
                                           else req.top_p),
-                               self._device))
+                               self._rep))
 
     def _admit_monolithic(self, req: _DecodeRequest, slot: int) -> int:
         """The single-plan admission: one prefill+insert dispatch for
@@ -1330,9 +1405,9 @@ class DecodeEngine:
         fn = self._admit_fn_for(req.bucket)
         # every host->device hop is explicit (device_put), so the loop
         # stays clean under zoolint.sanitize() transfer guards
-        prompt_dev = jax.device_put(req.prompt, self._device)
-        length_dev = jax.device_put(np.int32(req.length), self._device)
-        slot_dev = jax.device_put(np.int32(slot), self._device)
+        prompt_dev = jax.device_put(req.prompt, self._rep)
+        length_dev = jax.device_put(np.int32(req.length), self._rep)
+        slot_dev = jax.device_put(np.int32(slot), self._rep)
         scalars = self._samp_scalars(req)
         _profile.note_transfer("h2d")
         (self._caches, self._dcaches, self._tok, self._pos,

@@ -77,6 +77,7 @@ class InferenceModel:
                  decode_prefix_pool: int = 0,
                  decode_draft=None,
                  decode_spec_tokens: int = 4,
+                 mesh: Optional[dict] = None,
                  store_tag: Optional[str] = None):
         """``supported_concurrent_num`` bounds concurrent device work
         (reference semantics; PER REPLICA when replicated — the
@@ -126,6 +127,14 @@ class InferenceModel:
         * ``decode_draft`` — a small generation-capable draft net (or
           a ``(params, hyper)`` pair) enables speculative decoding of
           up to ``decode_spec_tokens`` tokens per dispatch.
+        * ``mesh`` — a sharded-serving spec dict (see
+          :func:`analytics_zoo_tpu.serving.shardgroup.normalize_mesh_spec`):
+          replicas become replica GROUPS, each a sharded executable
+          over a sub-mesh of that shape with the weight tree
+          partitioned by the spec's rule table — how a model bigger
+          than one chip serves.  ``replicas`` is ignored (the spec's
+          ``groups`` controls the group count), and the decode engine,
+          when configured, shards its slot arrays over the same mesh.
         """
         # per-model accounting tag for the persistent executable store
         # (``stat --by-model``): metadata on every entry this handle
@@ -156,6 +165,12 @@ class InferenceModel:
         self._decode_prefix_pool = int(decode_prefix_pool)
         self._decode_draft = decode_draft
         self._decode_spec_tokens = int(decode_spec_tokens)
+        # sharded serving: normalized once here so a malformed spec
+        # fails the CONSTRUCTOR (deploy-time), not the first install
+        if mesh is not None:
+            from ...serving.shardgroup import normalize_mesh_spec
+            mesh = normalize_mesh_spec(mesh)
+        self._mesh = mesh
         self._decode_engine: Optional[DecodeEngine] = None
         self._cache: Optional[BucketedExecutableCache] = None
         self._coalescer: Optional[RequestCoalescer] = None
@@ -247,6 +262,7 @@ class InferenceModel:
             prefix_pool=self._decode_prefix_pool,
             draft_params=draft_params, draft_hyper=draft_hyper,
             spec_tokens=self._decode_spec_tokens,
+            mesh=self._mesh,
             store_tag=self.store_tag)
         engine.warmup()
         return engine
@@ -376,7 +392,16 @@ class InferenceModel:
             # zero-compile even on one device.  Store off, one device:
             # the closure-jit path of PR 1, bit-for-bit unchanged.
             store_on = _execstore().current() is not None
-            if (n_rep > 1 or store_on) and replica_fn is not None:
+            if self._mesh is not None and replica_fn is not None:
+                # sharded serving: the mesh spec (not ``replicas``)
+                # decides how many groups the local device set carves
+                # into; one sharded compile, every further group is a
+                # device-assignment rewrite
+                from ...serving.shardgroup import ShardGroupSet
+                replica_set = ShardGroupSet(
+                    replica_fn, replica_params, self._mesh,
+                    devices=jax.local_devices(), tag=self.store_tag)
+            elif (n_rep > 1 or store_on) and replica_fn is not None:
                 replica_set = ReplicaSet(
                     replica_fn, replica_params,
                     devices=jax.local_devices()[:n_rep],
@@ -441,6 +466,19 @@ class InferenceModel:
         if cache is None or cache.replica_set is None:
             return 1
         return cache.replica_set.n_active
+
+    def placement_complete(self) -> bool:
+        """True when every replica (group) of the installed set holds
+        every placed executable — the pager's group-atomic install
+        guard.  Handles without a replica set are trivially complete
+        (one device, one executable)."""
+        fastpath = self._fastpath
+        if fastpath is None:
+            return False
+        _, cache, _ = fastpath
+        if cache is None or cache.replica_set is None:
+            return True
+        return cache.replica_set.placement_complete()
 
     def set_active_replicas(self, n: int) -> int:
         """Resize the scheduled replica set (the autoscaler's lever) —

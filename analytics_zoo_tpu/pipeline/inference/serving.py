@@ -225,16 +225,18 @@ class ReplicaSet:
         # per-model accounting tag for the executable store (stat
         # --by-model): rides every entry's header meta, never the key
         self._tag = tag
+        # the set's placement units: one device per replica here, one
+        # device GROUP (sub-mesh) per replica in ShardGroupSet — every
+        # hook below keys off the unit, so the compile-once/
+        # place-everywhere machinery is shared verbatim
+        units = self._carve_units(devices)
+        self._backend = self._unit_devices(units[0])[0].client
         # one jit wrapper for the whole set: every bucket's lowering
         # comes from it (a per-compile jax.jit would re-trace per call)
-        self._jit = jax.jit(fn)
-        devs = list(devices) if devices else list(jax.local_devices())
-        if not devs:
-            raise ValueError("ReplicaSet needs at least one device")
-        self._backend = devs[0].client
-        # params are placed per device ONCE at construction — the
+        self._jit = self._make_jit(units)
+        # params are placed per unit ONCE at construction — the
         # per-dispatch upload is the padded batch alone
-        placed0 = jax.device_put(params, devs[0])
+        placed0 = self._place_params(params, units[0])
         self._params_r0 = placed0
         # persistent executable store (read-through under
         # ensure_compiled, write-behind after each compile): "auto"
@@ -250,10 +252,10 @@ class ReplicaSet:
         # old ones.  Hashed once per set, at construction.
         self._wdigest = (_execstore().params_digest(placed0)
                          if store is not None else None)
-        replicas = [Replica(0, devs[0], jax.tree_util.tree_leaves(placed0))]
-        for i, d in enumerate(devs[1:], start=1):
-            replicas.append(Replica(
-                i, d, jax.tree_util.tree_leaves(jax.device_put(params, d))))
+        replicas = [self._make_replica(0, units[0], placed0)]
+        for i, u in enumerate(units[1:], start=1):
+            replicas.append(self._make_replica(
+                i, u, self._place_params(params, u)))
         self.replicas: Tuple[Replica, ...] = tuple(replicas)
         self._n_param_leaves = len(self.replicas[0].params_flat)
         # per-signature executables: key -> (exe per replica, kept
@@ -262,6 +264,7 @@ class ReplicaSet:
         self._exes: Dict[Tuple, Tuple] = {}
         self._kept: Dict[Tuple, Optional[Tuple[int, ...]]] = {}
         self._out_tree: Dict[Tuple, Any] = {}
+        self._out_avals: Dict[Tuple, List] = {}
         self._lock = threading.Lock()
         self._compile_locks: Dict[Tuple, threading.Lock] = {}
         self._rr = 0
@@ -272,6 +275,62 @@ class ReplicaSet:
         self._unhealthy_count = 0
         # serializes probes (dispatcher + solo threads may both ask)
         self._probe_guard = threading.Lock()
+
+    # ---- placement-unit hooks (overridden by ShardGroupSet) ----
+    # A "unit" is whatever one replica executes on: a single device
+    # here, a (devices, mesh) sub-mesh in serving/shardgroup.py.  The
+    # base class stays the single-device fast path — no mesh objects,
+    # no sharding branches on its dispatch.
+
+    def _carve_units(self, devices) -> List:
+        devs = list(devices) if devices else list(jax.local_devices())
+        if not devs:
+            raise ValueError("ReplicaSet needs at least one device")
+        return devs
+
+    @staticmethod
+    def _unit_devices(unit) -> Tuple:
+        """The concrete devices behind one unit (backend access)."""
+        return (unit,)
+
+    def _make_jit(self, units):
+        return jax.jit(self._fn)
+
+    def _place_params(self, params, unit):
+        return jax.device_put(params, unit)
+
+    def _make_replica(self, index: int, unit, placed) -> "Replica":
+        return Replica(index, unit, jax.tree_util.tree_leaves(placed))
+
+    def _input_sharding(self):
+        """The sharding batch inputs carry on replica 0 — the AOT
+        lowering's input placement (and, in ShardGroupSet, the
+        per-dispatch upload target)."""
+        return jax.sharding.SingleDeviceSharding(self.replicas[0].device)
+
+    def _fp_parts(self) -> Tuple:
+        """Leading fingerprint components: the entry kind plus any
+        layout extras that must rotate the store key.  ShardGroupSet
+        appends the canonical mesh spec here so two deploys differing
+        only in mesh shape / partition rules never share an entry."""
+        return ("replica-forward",)
+
+    def _store_meta(self) -> Dict[str, Any]:
+        """Header metadata every store entry of this set carries
+        (beyond kept/n_in/model, added by ensure_compiled)."""
+        return {"kind": "replica-forward"}
+
+    def span_labels(self, replica: "Replica") -> Dict[str, Any]:
+        """Labels the dispatch path stamps on request spans for this
+        unit.  ShardGroupSet adds ``group`` so a trace distinguishes
+        which replica group served the request."""
+        return {"replica": replica.index}
+
+    def _place_serialized(self, ser: bytes, replica: "Replica"):
+        """Rehydrate serialized-executable bytes onto one replica's
+        unit.  The base maps a replica to its single device; the
+        sharded set rewrites the assignment to span the whole group."""
+        return self._load_serialized(ser, replica.device)
 
     @property
     def n(self) -> int:
@@ -300,6 +359,19 @@ class ReplicaSet:
     def compiled_keys(self) -> int:
         """How many distinct signatures hold a placed executable."""
         return len(self._exes)
+
+    def placement_complete(self, key: Optional[Tuple] = None) -> bool:
+        """True when every replica holds an executable for ``key`` (or
+        for every placed key when None).  ensure_compiled publishes
+        full tuples under the lock, so this holds by construction on
+        any healthy set — it is the PAGER's install guard: a faulted-in
+        model whose replica (group) placement is incomplete must never
+        be published as resident, because for a sharded group partial
+        residency means wrong answers, not degraded capacity."""
+        with self._lock:
+            keys = [key] if key is not None else list(self._exes)
+            return all(len(self._exes[k]) == len(self.replicas)
+                       for k in keys if k in self._exes)
 
     def _load_serialized(self, ser: bytes, device):
         """Load serialized-executable bytes onto ``device``: fresh
@@ -349,7 +421,7 @@ class ReplicaSet:
                 return 0.0
             t0 = time.perf_counter()
             dev0 = self.replicas[0].device
-            s0 = jax.sharding.SingleDeviceSharding(dev0)
+            s0 = self._input_sharding()
             specs = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(
                     np.asarray(a).shape, np.asarray(a).dtype, sharding=s0),
@@ -367,7 +439,7 @@ class ReplicaSet:
             ser: Optional[bytes] = None
             if store is not None:
                 fp = store.fingerprint(
-                    "replica-forward", _execstore().hlo_digest(lowered),
+                    *self._fp_parts(), _execstore().hlo_digest(lowered),
                     self._wdigest, key, device=dev0)
                 ent = store.lookup(fp)
                 if ent is not None:
@@ -385,7 +457,8 @@ class ReplicaSet:
                                     f"kept indices {kept_t} out of "
                                     f"range for {n_in} inputs")
                         ser = ent.payload
-                        exe0 = self._load_serialized(ser, dev0)
+                        exe0 = self._place_serialized(
+                            ser, self.replicas[0])
                     except Exception as e:  # noqa: BLE001 — ANY load
                         # failure (truncated bytes, foreign artifact,
                         # bad metadata) must fall back to a fresh
@@ -422,22 +495,24 @@ class ReplicaSet:
                     # write-behind: the device-0 serialization the
                     # multi-replica path produces anyway, plus the
                     # metadata the raw dispatch path needs back
-                    meta = {"kind": "replica-forward", "kept": kept_t,
-                            "n_in": n_in}
+                    meta = dict(self._store_meta())
+                    meta.update({"kept": kept_t, "n_in": n_in})
                     if self._tag is not None:
                         meta["model"] = self._tag
                     store.put(fp, ser, meta=meta)
             exes = [exe0]
             # place everywhere: one serialization (from the compile or
-            # from the store entry), loaded per device with only the
+            # from the store entry), loaded per unit with only the
             # device assignment rewritten — a load, not a compile
             for rep in self.replicas[1:]:
-                exes.append(self._load_serialized(ser, rep.device))
-            out_tree = jax.tree_util.tree_structure(
-                jax.eval_shape(self._fn, self._params_r0, specs))
+                exes.append(self._place_serialized(ser, rep))
+            out_shapes = jax.eval_shape(self._fn, self._params_r0, specs)
+            out_tree = jax.tree_util.tree_structure(out_shapes)
+            out_avals = jax.tree_util.tree_leaves(out_shapes)
             with self._lock:
                 self._kept[key] = kept_t
                 self._out_tree[key] = out_tree
+                self._out_avals[key] = out_avals
                 self._exes[key] = tuple(exes)  # publish last
             return time.perf_counter() - t0
 
@@ -790,7 +865,8 @@ class BucketedExecutableCache:
         if replica is None:
             replica = rs.pick()
         for s in spans:
-            s.set_label("replica", replica.index)
+            for lk, lv in rs.span_labels(replica).items():
+                s.set_label(lk, lv)
         try:
             out = rs.dispatch(replica, batched, spans, key=key)
         except RuntimeError as e:
@@ -804,7 +880,8 @@ class BucketedExecutableCache:
             if alt is None:
                 raise
             for s in spans:
-                s.set_label("replica", alt.index)
+                for lk, lv in rs.span_labels(alt).items():
+                    s.set_label(lk, lv)
                 s.event("replica_retry", failed=replica.index,
                         error=type(e).__name__)
             try:

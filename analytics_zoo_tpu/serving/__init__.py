@@ -17,11 +17,15 @@ from .metrics import (Counters, LatencyWindow, registry_collector,
                       registry_families)
 from .pager import ModelPager, PageRecipe
 from .registry import ModelRegistry
+from .shardgroup import (ShardGroup, ShardGroupSet, carve_groups,
+                         normalize_mesh_spec)
 
 __all__ = [
     "AdmissionController", "Autoscaler", "ColdStartTimeout", "Counters",
     "DeadlineExceeded", "DeployError", "ExecStore", "LatencyWindow",
     "ModelNotFound", "ModelPager", "ModelRegistry", "Overloaded",
-    "PageRecipe", "ServingError", "autoscaler_for", "error_response",
-    "execstore", "fleet", "registry_collector", "registry_families",
+    "PageRecipe", "ServingError", "ShardGroup", "ShardGroupSet",
+    "autoscaler_for", "carve_groups", "error_response", "execstore",
+    "fleet", "normalize_mesh_spec", "registry_collector",
+    "registry_families",
 ]

@@ -406,11 +406,25 @@ class ExecStore:
                 meta = json.loads(head).get("meta", {})
                 kind = meta.get("kind", "?")
                 model = meta.get("model", "-")
+                mesh = _mesh_label(meta.get("mesh"))
             except Exception:  # noqa: BLE001 — stat must never crash
-                kind, model = "unreadable", "-"
+                kind, model, mesh = "unreadable", "-", "-"
             out.append({"fingerprint": fp, "bytes": size,
-                        "mtime": mtime, "kind": kind, "model": model})
+                        "mtime": mtime, "kind": kind, "model": model,
+                        "mesh": mesh})
         return out
+
+    def by_mesh(self) -> Dict[str, Dict[str, int]]:
+        """Entries/bytes aggregated by the writer's ``mesh`` meta tag
+        (``axes`` x ``strategy``; ``-`` for single-device entries) —
+        the sharded-serving operator's view of how much of the store
+        each mesh layout occupies."""
+        agg: Dict[str, Dict[str, int]] = {}
+        for e in self.entries():
+            row = agg.setdefault(e["mesh"], {"entries": 0, "bytes": 0})
+            row["entries"] += 1
+            row["bytes"] += e["bytes"]
+        return agg
 
     def by_model(self) -> Dict[str, Dict[str, int]]:
         """Entries/bytes aggregated by the writer's ``model`` meta tag
@@ -423,6 +437,18 @@ class ExecStore:
             row["entries"] += 1
             row["bytes"] += e["bytes"]
         return agg
+
+
+def _mesh_label(mesh) -> str:
+    """Collapse a header ``mesh`` meta dict to a stable short label
+    for aggregation: ``tensor=2/tp`` (axes sorted by name).  ``-``
+    for entries written by single-device sets."""
+    if not isinstance(mesh, dict):
+        return "-"
+    axes = mesh.get("axes")
+    parts = ",".join(f"{k}={v}" for k, v in sorted(axes.items())) \
+        if isinstance(axes, dict) and axes else "?"
+    return f"{parts}/{mesh.get('strategy', '?')}"
 
 
 _FAMILY_HELP = {
@@ -500,6 +526,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_stat.add_argument("--by-model", action="store_true",
                         help="aggregate entries/bytes per model tag "
                              "(the registry name each deploy wrote)")
+    p_stat.add_argument("--by-mesh", action="store_true",
+                        help="aggregate entries/bytes per mesh layout "
+                             "(axes x strategy; '-' = single-device)")
     p_gc = sub.add_parser("gc", parents=[common],
                           help="LRU-evict down to a byte budget")
     p_gc.add_argument("--budget", type=int, default=None,
@@ -515,19 +544,22 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{s['bytes']:,} bytes"
               + (f" (budget {s['byte_budget']:,})"
                  if s["byte_budget"] else ""))
-        if getattr(args, "by_model", False):
+        if getattr(args, "by_model", False) \
+                or getattr(args, "by_mesh", False):
             # largest first: the density question is "what is eating
             # the store", answered top-down
-            agg = sorted(store.by_model().items(),
-                         key=lambda kv: -kv[1]["bytes"])
-            for model, row in agg:
-                print(f"  {model:<24} {row['entries']:>5} entries  "
+            table = store.by_mesh() if getattr(args, "by_mesh", False) \
+                else store.by_model()
+            agg = sorted(table.items(), key=lambda kv: -kv[1]["bytes"])
+            for tag, row in agg:
+                print(f"  {tag:<24} {row['entries']:>5} entries  "
                       f"{row['bytes']:>12,} B")
             return 0
         for e in store.entries():
             age = time.time() - e["mtime"]
             print(f"  {e['fingerprint'][:16]}  {e['bytes']:>10,} B  "
-                  f"{age:>8.0f}s old  {e['kind']}  {e['model']}")
+                  f"{age:>8.0f}s old  {e['kind']}  {e['model']}  "
+                  f"{e['mesh']}")
         return 0
     budget = args.budget
     if budget is None:

@@ -140,4 +140,10 @@ def build_deploy_kwargs(spec: Dict[str, Any],
     if spec.get("warmup_shapes") is not None:
         kwargs.setdefault("warmup_shapes",
                           tuple(spec["warmup_shapes"]))
+    if spec.get("mesh") is not None:
+        # the mesh section is pinned at the spec's top level (like
+        # warmup_shapes) so every worker carves identical sub-meshes
+        # and the sharded executables' fingerprints line up across
+        # the fleet — same partition rules, same store entry
+        kwargs.setdefault("mesh", spec["mesh"])
     return kwargs

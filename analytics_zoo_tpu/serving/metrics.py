@@ -59,11 +59,13 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
     replica_counters: Dict[str, List] = {
         "zoo_replica_dispatches_total": [],
         "zoo_replica_bucket_dispatches_total": [],
+        "zoo_group_dispatches_total": [],
     }
     replica_gauges: Dict[str, List] = {
         "zoo_replica_unhealthy": [],
         "zoo_model_replicas": [],
         "zoo_model_replicas_active": [],
+        "zoo_model_groups": [],
     }
     # elastic serving: per-class admission + hedge outcomes
     class_counters: Dict[str, List] = {
@@ -245,6 +247,17 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
                         "zoo_replica_bucket_dispatches_total"].append(
                         ({"model": model, "replica": str(rep),
                           "bucket": str(bucket)}, v))
+        # sharded serving: replica GROUPS (pjit executables over
+        # sub-meshes) export their own count + per-group dispatch
+        # counters, keyed "group" so dashboards distinguish them from
+        # single-device replicas
+        if serving.get("groups"):
+            replica_gauges["zoo_model_groups"].append(
+                (ml, serving["groups"]))
+            for grp, v in sorted(
+                    serving.get("group_dispatches", {}).items()):
+                replica_counters["zoo_group_dispatches_total"].append(
+                    ({"model": model, "group": str(grp)}, v))
 
     help_text = {
         "zoo_model_active_version": "active (serving) version number",
@@ -277,6 +290,11 @@ def registry_families(snapshot: Dict[str, Any]) -> List[Family]:
             "dispatch (restored to 0 by a successful health re-probe)",
         "zoo_model_replicas_active":
             "replicas in the scheduled (elastic) set",
+        "zoo_model_groups":
+            "sharded replica groups (pjit sub-mesh executables) "
+            "serving this model",
+        "zoo_group_dispatches_total":
+            "device dispatches executed per replica group",
         "zoo_shed_total":
             "requests shed per priority class (all shed causes)",
         "zoo_class_admitted_total":

@@ -285,6 +285,17 @@ class ModelPager:
             # call graph
             rebuild_cold = recipe.build
             model = rebuild_cold(span=span)
+            # group-atomic fault: a sharded model whose replica-group
+            # placement came back incomplete must FAIL the fault (the
+            # entry stays cold, the requester gets the error) rather
+            # than install — a partially-resident group serves wrong
+            # answers, not slower ones
+            check = getattr(model, "placement_complete", None)
+            if check is not None and not check():
+                raise RuntimeError(
+                    f"model {entry.name!r} rebuilt with incomplete "
+                    "replica-group placement — refusing to install a "
+                    "partially resident group")
             build_s = time.perf_counter() - t_build
         except BaseException as e:
             with self._cond:

@@ -94,7 +94,14 @@ DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        # the whole point is shaving per-hop copies, so
                        # a stray materialization or free-text log here
                        # pays twice per request
-                       "encode_binary", "decode_binary")
+                       "encode_binary", "decode_binary",
+                       # sharded serving: the group-atomic placement
+                       # check (gates every paged install) and the
+                       # span labeler (stamped on every dispatch) run
+                       # on the request path — a stray sync or free-
+                       # text log in either taxes every sharded
+                       # request
+                       "placement_complete", "span_labels")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

@@ -3723,6 +3723,329 @@ def density_bench(quick: bool = False, selfcheck: bool = False,
     return rc
 
 
+# ------------------------------------------------------------- sharded ----
+
+def _sharded_config(quick: bool) -> dict:
+    """Shared recipe for the sharded-serving drill: one seeded MLP
+    served 1-group-of-2 over 4 forced host devices (2 groups), plus a
+    small TransformerLM for the sharded decode leg."""
+    if quick:
+        return {"layers": 4, "d_in": 32, "max_batch": 8,
+                "requests": 60, "pager_requests": 24,
+                "dec_vocab": 64, "dec_seq": 48, "dec_bucket": 16,
+                "dec_capacity": 4, "dec_streams": 4, "dec_tokens": 8}
+    return {"layers": 8, "d_in": 64, "max_batch": 16,
+            "requests": 200, "pager_requests": 60,
+            "dec_vocab": 128, "dec_seq": 96, "dec_bucket": 32,
+            "dec_capacity": 8, "dec_streams": 8, "dec_tokens": 16}
+
+
+def _write_sharded_trajectory(results: dict, rc: int) -> str:
+    import re as _re
+
+    ns = []
+    for p in glob.glob(os.path.join(REPO, "BENCH_SHARDED_r*.json")):
+        m = _re.search(r"BENCH_SHARDED_r(\d+)\.json$", p)
+        if m:
+            ns.append(int(m.group(1)))
+    n = max(ns, default=0) + 1
+    path = os.path.join(REPO, f"BENCH_SHARDED_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"n": n,
+                   "cmd": "python bench.py sharded "
+                          + " ".join(sys.argv[2:]),
+                   "rc": rc, "parsed": results}, f, indent=2)
+    return path
+
+
+def sharded_bench(quick: bool = False, selfcheck: bool = False,
+                  out_path: str = None) -> int:
+    """Sharded-serving drill (``bench.py sharded``): serve one model
+    as replica GROUPS (pjit sub-mesh executables, ``tensor=2`` over 4
+    forced host devices -> 2 groups) and gate the mechanisms:
+
+    * SHARDED_BITEXACT — every group's result is bit-identical to the
+      single-device jit (the default column rule gathers, never
+      psums), through the full registry dispatch path;
+    * SHARDED_ZERO_COMPILE — the whole 2-group set compiles ONCE
+      (group 2 is a deserialize with a rewritten device assignment,
+      ``group2=0`` extra compiles), and a warm-store re-deploy
+      compiles ZERO times end to end;
+    * SHARDED_FINGERPRINT — deploys differing only in mesh shape or
+      only in partition rules write DISTINCT execstore entries (and
+      ``by_mesh`` sees the layouts);
+    * SHARDED_PAGER_ATOMIC — a paged sharded model fault/evict-churns
+      bit-exactly, and a rebuild whose group placement comes back
+      incomplete is REFUSED (the entry stays cold — partial residency
+      would serve wrong answers);
+    * SHARDED_DECODE — the slot engine with sharded state arrays
+      streams bit-identically to the single-device engine, sampling
+      included.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from jax._src import monitoring
+
+    compile_events = []
+    monitoring.register_event_duration_secs_listener(
+        lambda k, d, **kw: (compile_events.append(k)
+                            if "backend_compile" in k else None))
+
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.serving import (ModelRegistry, ShardGroupSet,
+                                           execstore)
+
+    cfg = _sharded_config(quick)
+    work = tempfile.mkdtemp(prefix="zoo_sharded_")
+    results = {"quick": quick, "config": cfg}
+    ok = True
+
+    n_devices = len(jax.local_devices())
+    if n_devices < 4:
+        _log(f"sharded FAIL: needs >= 4 devices, have {n_devices} "
+             "(run under XLA_FLAGS="
+             "--xla_force_host_platform_device_count=4)")
+        return 1
+
+    n_layers, d_in = cfg["layers"], cfg["d_in"]
+
+    def mlp(p, x):
+        h = x
+        for i in range(n_layers):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return h
+
+    def mk_params(seed):
+        rng = np.random.default_rng(seed)
+        return {f"w{i}": rng.normal(size=(d_in, d_in)).astype(np.float32)
+                * 0.2 for i in range(n_layers)}
+
+    params = mk_params(0)
+    rng = np.random.default_rng(7)
+    x_eval = rng.normal(size=(cfg["max_batch"] // 2, d_in)
+                        ).astype(np.float32)
+
+    try:
+        # ---- leg 1: direct set — bit-exact groups, one compile ----
+        _log("sharded: 2 groups of 2 over 4 devices (store off)")
+        execstore.disable()
+        expected = np.asarray(jax.jit(mlp)(params, x_eval))
+        c0 = len(compile_events)
+        sgs = ShardGroupSet(mlp, params, {"axes": {"tensor": 2}})
+        sgs.ensure_compiled(x_eval)
+        set_compiles = len(compile_events) - c0
+        group_outs = [np.asarray(jax.device_get(
+                          sgs.dispatch(g, x_eval)))
+                      for g in sgs.groups]
+        exact = [bool(np.array_equal(o, expected)) for o in group_outs]
+        group2_extra = set_compiles - 1
+        results.update({"groups": len(sgs.groups),
+                        "set_compiles": set_compiles,
+                        "groups_bitexact": exact})
+        bitexact_ok = all(exact) and len(sgs.groups) == 2
+        zero_ok = set_compiles == 1
+        print(f"SHARDED_BITEXACT_{'OK' if bitexact_ok else 'FAIL'} "
+              f"groups={len(sgs.groups)} "
+              f"exact={sum(exact)}/{len(exact)} "
+              + ("PASS" if bitexact_ok else "FAIL"), flush=True)
+        del sgs
+
+        # ---- leg 2: warm store — re-deploy compiles nothing ----
+        execstore.configure(os.path.join(work, "execstore"))
+        reg = ModelRegistry(max_batch_size=cfg["max_batch"])
+        reg.deploy("m", jax_fn=mlp, params=params,
+                   mesh={"axes": {"tensor": 2}},
+                   warmup_shapes=(d_in,))
+        out1 = np.asarray(reg.predict("m", x_eval))
+        reg.undeploy("m")
+        c1 = len(compile_events)
+        reg.deploy("m", jax_fn=mlp, params=params,
+                   mesh={"axes": {"tensor": 2}},
+                   warmup_shapes=(d_in,))
+        out2 = np.asarray(reg.predict("m", x_eval))
+        warm_compiles = len(compile_events) - c1
+        warm_exact = (bool(np.array_equal(out1, expected))
+                      and bool(np.array_equal(out2, expected)))
+        results.update({"warm_redeploy_compiles": warm_compiles,
+                        "registry_bitexact": warm_exact})
+        zero_ok = zero_ok and warm_compiles == 0 and warm_exact
+        print(f"SHARDED_ZERO_COMPILE group2={group2_extra} "
+              f"warm_redeploy={warm_compiles} "
+              + ("PASS" if zero_ok else "FAIL"), flush=True)
+
+        # ---- leg 3: fingerprints rotate on mesh / rules alone ----
+        # same fn + weights, three layouts: the store must hold three
+        # distinct shardgroup entries (sharing any would serve a
+        # wrongly-partitioned executable)
+        reg.deploy("fp_mesh", jax_fn=mlp, params=params,
+                   mesh={"axes": {"tensor": 1}},
+                   warmup_shapes=(d_in,))
+        reg.predict("fp_mesh", x_eval)
+        reg.deploy("fp_rules", jax_fn=mlp, params=params,
+                   mesh={"axes": {"tensor": 2},
+                         "rules": {r"w\d+": 1}},
+                   warmup_shapes=(d_in,))
+        reg.predict("fp_rules", x_eval)
+        st = execstore.current()
+        shard_fps = {e["fingerprint"] for e in st.entries()
+                     if e["kind"] == "shardgroup-forward"}
+        meshes = set(st.by_mesh())
+        fp_ok = len(shard_fps) >= 3 and len(meshes) >= 2
+        results.update({"shard_fingerprints": len(shard_fps),
+                        "mesh_layouts": sorted(meshes)})
+        print(f"SHARDED_FINGERPRINT entries={len(shard_fps)} "
+              f"layouts={len(meshes)} "
+              + ("PASS" if fp_ok else "FAIL"), flush=True)
+        reg.shutdown()
+
+        # ---- leg 4: pager faults/evicts a group atomically ----
+        _log("sharded: paged 2-model churn at budget 1")
+        preg = ModelRegistry(max_batch_size=cfg["max_batch"],
+                             pager={"max_resident": 1,
+                                    "fault_timeout_s": 120.0})
+        p2 = mk_params(1)
+        exp2 = np.asarray(jax.jit(mlp)(p2, x_eval))
+        preg.deploy("pa", jax_fn=mlp, params=params,
+                    mesh={"axes": {"tensor": 2}},
+                    warmup_shapes=(d_in,))
+        preg.deploy("pb", jax_fn=mlp, params=p2,
+                    mesh={"axes": {"tensor": 2}},
+                    warmup_shapes=(d_in,))
+        wrong = 0
+        for i in range(cfg["pager_requests"]):
+            name, want = (("pa", expected), ("pb", exp2))[i % 2]
+            got = np.asarray(preg.predict(name, x_eval))
+            if not np.array_equal(got, want):
+                wrong += 1
+        snap = preg.pager.snapshot()["models"]
+        churn = sum(m["fault_ok"] for m in snap.values())
+        # partial placement must refuse to install: poison the
+        # rebuilt model's placement check and fault the cold model
+        from analytics_zoo_tpu.pipeline.inference import (
+            inference_model as _imod)
+        cold = next(n for n in ("pa", "pb")
+                    if preg._entries[n].pager_state != "resident")
+        orig_pc = _imod.InferenceModel.placement_complete
+        _imod.InferenceModel.placement_complete = lambda self: False
+        refused = False
+        try:
+            preg.predict(cold, x_eval)
+        except Exception:  # noqa: BLE001 — the refusal IS the gate
+            refused = True
+        finally:
+            _imod.InferenceModel.placement_complete = orig_pc
+        still_cold = preg._entries[cold].pager_state != "resident"
+        fault_errors = sum(
+            m["fault_error"]
+            for m in preg.pager.snapshot()["models"].values())
+        # and the un-poisoned retry serves bit-exactly again
+        recovered = bool(np.array_equal(
+            np.asarray(preg.predict(cold, x_eval)),
+            expected if cold == "pa" else exp2))
+        pager_ok = (wrong == 0 and churn >= 2 and refused
+                    and still_cold and fault_errors >= 1 and recovered)
+        results.update({"pager_wrong": wrong, "pager_faults": churn,
+                        "partial_refused": refused,
+                        "stayed_cold": still_cold,
+                        "recovered": recovered})
+        print(f"SHARDED_PAGER_ATOMIC wrong={wrong} faults={churn} "
+              f"refused={refused} stayed_cold={still_cold} "
+              f"recovered={recovered} "
+              + ("PASS" if pager_ok else "FAIL"), flush=True)
+        preg.shutdown()
+        execstore.disable()
+
+        # ---- leg 5: sharded decode bit-exact vs single-device ----
+        _log("sharded: decode engine, sharded slot arrays")
+        from analytics_zoo_tpu.models import TransformerLM
+        from analytics_zoo_tpu.pipeline.inference.decode import (
+            DecodeEngine)
+        lm = TransformerLM(vocab_size=cfg["dec_vocab"],
+                           seq_len=cfg["dec_seq"], n_layers=2,
+                           d_model=32, n_heads=2)
+        lm.ensure_inference_ready()
+        lp = lm.trainer.state.params
+        drng = np.random.default_rng(3)
+        prompts = [drng.integers(0, cfg["dec_vocab"],
+                                 int(drng.integers(4, cfg["dec_bucket"])))
+                   for _ in range(cfg["dec_streams"])]
+
+        def run(mesh):
+            eng = DecodeEngine(lp, lm.hyper,
+                               capacity=cfg["dec_capacity"],
+                               max_len=cfg["dec_seq"],
+                               prompt_buckets=(cfg["dec_bucket"],),
+                               mesh=mesh)
+            outs = []
+            try:
+                streams = [eng.submit(
+                               p, max_new_tokens=cfg["dec_tokens"],
+                               temperature=0.7, seed=i)
+                           for i, p in enumerate(prompts)]
+                outs = [list(s.result()) for s in streams]
+            finally:
+                eng.close()
+            return outs
+
+        ref_toks = run(None)
+        sh_toks = run({"axes": {"tensor": 2}})
+        dec_ok = ref_toks == sh_toks
+        results.update({"decode_streams": len(ref_toks),
+                        "decode_bitexact": dec_ok})
+        print(f"SHARDED_DECODE streams={len(ref_toks)} "
+              f"exact={dec_ok} " + ("PASS" if dec_ok else "FAIL"),
+              flush=True)
+
+        if selfcheck:
+            for cond, msg in (
+                    (bitexact_ok, "a group's result diverged from the "
+                                  "single-device jit"),
+                    (zero_ok, "the set compiled more than once or the "
+                              "warm re-deploy compiled"),
+                    (fp_ok, "mesh/rules-only changes shared a store "
+                            "entry"),
+                    (pager_ok, "paged churn went wrong or a partial "
+                               "placement installed"),
+                    (dec_ok, "sharded decode diverged")):
+                if not cond:
+                    _log(f"sharded FAIL: {msg}")
+                    ok = False
+            if ok:
+                _log(f"sharded selfcheck: 2 groups bit-exact, "
+                     f"{group2_extra} extra compiles for group 2, "
+                     f"warm re-deploy 0 compiles, {len(shard_fps)} "
+                     f"distinct layout fingerprints, group-atomic "
+                     f"pager, decode bit-exact")
+    except Exception as e:  # noqa: BLE001 — a crashed drill must
+        # still print its report line
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        _log(f"sharded FAIL: {type(e).__name__}: {e}")
+        results["error"] = f"{type(e).__name__}: {e}"
+        ok = False
+    finally:
+        execstore.disable()
+        shutil.rmtree(work, ignore_errors=True)
+
+    print("BENCH_SHARDED " + json.dumps(results), flush=True)
+    rc = 0 if (ok or not selfcheck) else 1
+    if not quick and "error" not in results:
+        path = _write_sharded_trajectory(results, rc)
+        _log(f"sharded trajectory written: {os.path.basename(path)}")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+    if selfcheck:
+        print("SHARDED_SELFCHECK_" + ("OK" if ok else "FAIL"),
+              flush=True)
+    return rc
+
+
 # ----------------------------------------------------------- faulttrain ----
 
 def _faulttrain_worker(argv) -> int:
@@ -4835,6 +5158,21 @@ if __name__ == "__main__":
         if "--out" in sys.argv:
             _out = sys.argv[sys.argv.index("--out") + 1]
         sys.exit(density_bench(quick="--quick" in sys.argv,
+                               selfcheck="--selfcheck" in sys.argv,
+                               out_path=_out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "sharded":
+        # 2 groups of 2 need 4 devices: force 4 virtual host devices
+        # BEFORE jax initializes (no-op when the caller already set a
+        # count; real-TPU runs see the board's own chips)
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+        _out = None
+        if "--out" in sys.argv:
+            _out = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(sharded_bench(quick="--quick" in sys.argv,
                                selfcheck="--selfcheck" in sys.argv,
                                out_path=_out))
     elif len(sys.argv) > 1 and sys.argv[1] == "loadtest":

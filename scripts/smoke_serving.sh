@@ -200,4 +200,35 @@ grep -q "FLEET_SELFCHECK_OK" <<<"$fl" || {
     echo "smoke FAIL: fleet selfcheck gates failed" >&2
     exit 1
 }
+
+# Sharded-serving gate: replica GROUPS over sub-meshes (2 groups of 2
+# on 4 forced host devices).  Every group must serve bit-identically
+# to the single-device jit (the column rule gathers, never psums),
+# the second group must be a deserialize — zero extra compiles — and
+# a warm-store re-deploy must compile nothing; the pager must refuse
+# a partially placed group (group-atomic residency), and the sharded
+# decode engine must stream bit-identically to the unsharded one.
+sh=$(timeout -k 10 590 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+    python bench.py sharded --quick --selfcheck)
+printf '%s\n' "$sh"
+grep -Eq "SHARDED_BITEXACT_OK .*PASS" <<<"$sh" || {
+    echo "smoke FAIL: a replica group diverged from the" \
+         "single-device jit" >&2
+    exit 1
+}
+grep -Eq "SHARDED_ZERO_COMPILE group2=0 warm_redeploy=0 PASS" <<<"$sh" || {
+    echo "smoke FAIL: group 2 or the warm re-deploy compiled" \
+         "(placement must be a deserialize)" >&2
+    exit 1
+}
+grep -Eq "SHARDED_PAGER_ATOMIC wrong=0 .*refused=True .*PASS" <<<"$sh" || {
+    echo "smoke FAIL: sharded paging went wrong or a partial group" \
+         "placement was installed" >&2
+    exit 1
+}
+grep -q "SHARDED_SELFCHECK_OK" <<<"$sh" || {
+    echo "smoke FAIL: sharded selfcheck gates failed" >&2
+    exit 1
+}
 echo "serving smoke OK"

@@ -1,0 +1,368 @@
+"""Sharded serving: replica groups over device sub-meshes (ISSUE 17).
+
+The pinned contracts:
+* a replica GROUP serves bit-identically to the single-device jit —
+  the default column (last-axis) rule partitions matmuls over their
+  output dimension, so results are gathered, never psummed;
+* compile-once/place-everywhere survives the generalization: the whole
+  M-group set pays ONE compile per bucket (group 2..M rehydrate the
+  serialized executable with only the device assignment rewritten),
+  and a warm execstore makes a whole second set zero-compile;
+* the store key is layout-aware: deploys differing ONLY in mesh shape
+  or ONLY in partition rules write DISTINCT entries (sharing one would
+  serve a wrongly partitioned executable), and ``by_mesh`` breaks the
+  store down by layout;
+* the pager faults/evicts a group's weight tree ATOMICALLY: a rebuild
+  whose placement comes back incomplete is refused (the entry stays
+  cold — partial residency means wrong answers), concurrent fault +
+  evict churn never serves a wrong result, and undeploy racing a
+  mid-group fault discards the rebuild on the generation check;
+* the decode engine's sharded slot arrays stream bit-identically to
+  the single-device engine, sampling included.
+
+Runs on the conftest's 8 virtual CPU devices.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax._src import monitoring
+
+from analytics_zoo_tpu.serving import (ModelNotFound, ModelRegistry,
+                                       ShardGroupSet, carve_groups,
+                                       execstore, normalize_mesh_spec,
+                                       registry_families)
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.pipeline.inference import inference_model as _imod
+
+D_IN = 16
+X = np.arange(4 * D_IN, dtype=np.float32).reshape(4, D_IN) * 0.01
+
+
+def _mlp_fn():
+    def fn(p, x):
+        return jnp.tanh(x @ p["w0"]) @ p["w1"]
+    rng = np.random.default_rng(0)
+    params = {"w0": rng.normal(size=(D_IN, D_IN)).astype(np.float32) * 0.3,
+              "w1": rng.normal(size=(D_IN, D_IN)).astype(np.float32) * 0.3}
+    return fn, params
+
+
+_COMPILE_EVENTS = []
+monitoring.register_event_duration_secs_listener(
+    lambda k, d, **kw: (_COMPILE_EVENTS.append(k)
+                        if "backend_compile" in k else None))
+
+
+@pytest.fixture
+def compile_counter():
+    # one module-level listener; each test reads deltas off the shared
+    # event list (unregistering is private API)
+    return _COMPILE_EVENTS
+
+
+# ------------------------------------------------------------ mesh spec
+def test_mesh_spec_validation_errors():
+    with pytest.raises(ValueError):
+        normalize_mesh_spec({"axes": {"bogus_axis": 2}})
+    with pytest.raises(ValueError):
+        normalize_mesh_spec({"axes": {"tensor": 0}})
+    with pytest.raises(ValueError):
+        normalize_mesh_spec({"axes": {"tensor": 2},
+                             "strategy": "bogus"})
+    with pytest.raises(ValueError):
+        normalize_mesh_spec({"axes": {"tensor": 2}, "groups": -1})
+    with pytest.raises(ValueError):
+        normalize_mesh_spec({"axes": {"tensor": 2}, "unknown_key": 1})
+
+
+def test_carve_groups_shapes():
+    devs = jax.local_devices()
+    spec = normalize_mesh_spec({"axes": {"tensor": 2}})
+    groups = carve_groups(devs, spec)
+    assert len(groups) == len(devs) // 2
+    for gdevs, mesh in groups:
+        assert len(gdevs) == 2
+        assert mesh.axis_names == ("tensor",)
+    # explicit group count clamps the carve
+    spec2 = normalize_mesh_spec({"axes": {"tensor": 2}, "groups": 2})
+    assert len(carve_groups(devs, spec2)) == 2
+    # a group bigger than the host is an error, not a silent clamp
+    spec3 = normalize_mesh_spec({"axes": {"tensor": len(devs) * 2}})
+    with pytest.raises(ValueError):
+        carve_groups(devs, spec3)
+
+
+# ------------------------------------------- bit-exactness + one compile
+def test_groups_bitexact_vs_single_device_one_compile(compile_counter):
+    fn, params = _mlp_fn()
+    expected = np.asarray(jax.jit(fn)(params, X))
+    n0 = len(compile_counter)
+    sgs = ShardGroupSet(fn, params, {"axes": {"tensor": 2}},
+                        devices=jax.local_devices()[:4])
+    sgs.ensure_compiled(X)
+    # compile-once/place-everywhere at group granularity: group 2 is a
+    # deserialize with a rewritten device assignment, not a compile
+    assert len(compile_counter) - n0 == 1
+    assert len(sgs.groups) == 2
+    for g in sgs.groups:
+        out = np.asarray(jax.device_get(sgs.dispatch(g, X)))
+        assert np.array_equal(out, expected)
+    st = sgs.stats()
+    assert st["groups"] == 2 and st["group_size"] == 2
+    assert st["mesh_axes"] == {"tensor": 2}
+
+
+def test_placement_complete_tracks_group_placement():
+    fn, params = _mlp_fn()
+    sgs = ShardGroupSet(fn, params, {"axes": {"tensor": 2}},
+                        devices=jax.local_devices()[:4])
+    sgs.ensure_compiled(X)
+    assert sgs.placement_complete()
+    # drop one group's executable: the check must read incomplete
+    key = next(iter(sgs._exes))
+    sgs._exes[key] = sgs._exes[key][:1]
+    assert not sgs.placement_complete()
+
+
+# --------------------------------------------------------- warm store
+def test_warm_store_second_set_zero_compiles(tmp_path, compile_counter):
+    fn, params = _mlp_fn()
+    execstore.configure(str(tmp_path / "store"))
+    try:
+        expected = np.asarray(jax.jit(fn)(params, X))
+        s1 = ShardGroupSet(fn, params, {"axes": {"tensor": 2}},
+                           devices=jax.local_devices()[:4])
+        s1.ensure_compiled(X)
+        n0 = len(compile_counter)
+        s2 = ShardGroupSet(fn, params, {"axes": {"tensor": 2}},
+                           devices=jax.local_devices()[:4])
+        s2.ensure_compiled(X)
+        assert len(compile_counter) - n0 == 0
+        for g in s2.groups:
+            out = np.asarray(jax.device_get(s2.dispatch(g, X)))
+            assert np.array_equal(out, expected)
+    finally:
+        execstore.disable()
+
+
+def test_fingerprint_rotates_on_mesh_only_and_rules_only(tmp_path):
+    fn, params = _mlp_fn()
+    execstore.configure(str(tmp_path / "store"))
+    try:
+        devs = jax.local_devices()[:4]
+        for spec in ({"axes": {"tensor": 2}},
+                     {"axes": {"tensor": 1}},            # mesh-only diff
+                     {"axes": {"tensor": 2},
+                      "rules": {r"w\d+": 1}}):           # rules-only diff
+            s = ShardGroupSet(fn, params, spec, devices=devs)
+            s.ensure_compiled(X)
+        st = execstore.current()
+        fps = {e["fingerprint"] for e in st.entries()
+               if e["kind"] == "shardgroup-forward"}
+        assert len(fps) == 3
+        # the stat breakdown sees both layouts
+        assert set(st.by_mesh()) == {"tensor=1/tp", "tensor=2/tp"}
+    finally:
+        execstore.disable()
+
+
+# ----------------------------------------------------- model integration
+def test_inference_model_mesh_integration():
+    fn, params = _mlp_fn()
+    expected = np.asarray(jax.jit(fn)(params, X))
+    m = InferenceModel(mesh={"axes": {"tensor": 2}}).load_jax(fn, params)
+    try:
+        assert np.array_equal(np.asarray(m.predict(X)), expected)
+        assert m.placement_complete()
+        st = m.serving_stats()
+        assert st["groups"] == len(jax.local_devices()) // 2
+        assert st["group_size"] == 2
+    finally:
+        m.close()
+
+
+def test_registry_mesh_deploy_and_group_families():
+    fn, params = _mlp_fn()
+    expected = np.asarray(jax.jit(fn)(params, X))
+    with ModelRegistry() as reg:
+        reg.deploy("shard", jax_fn=fn, params=params,
+                   mesh={"axes": {"tensor": 2}, "groups": 2},
+                   warmup_shapes=(D_IN,))
+        for _ in range(4):
+            assert np.array_equal(np.asarray(reg.predict("shard", X)),
+                                  expected)
+        fams = {f.name: f for f in registry_families(reg.metrics())}
+        assert fams["zoo_model_groups"].samples[0][1] == 2
+        disp = {s[0]["group"]: s[1]
+                for s in fams["zoo_group_dispatches_total"].samples}
+        assert sum(disp.values()) >= 4
+
+
+# ------------------------------------------------- group-atomic paging
+def _paged_mesh_registry():
+    return ModelRegistry(max_concurrency=2,
+                         pager={"max_resident": 1,
+                                "quiesce_timeout_s": 1.0})
+
+
+def _deploy_mesh(reg, name, fn, params):
+    reg.deploy(name, jax_fn=fn, params=params,
+               mesh={"axes": {"tensor": 2}, "groups": 2},
+               warmup_shapes=(D_IN,))
+
+
+def test_pager_refuses_partial_group_placement():
+    fn, params = _mlp_fn()
+    expected = np.asarray(jax.jit(fn)(params, X))
+    with _paged_mesh_registry() as reg:
+        _deploy_mesh(reg, "a", fn, params)
+        _deploy_mesh(reg, "b", fn, params)
+        reg.predict("b", X)  # a cold
+        assert reg._entries["a"].pager_state != "resident"
+        orig = _imod.InferenceModel.placement_complete
+        _imod.InferenceModel.placement_complete = lambda self: False
+        try:
+            with pytest.raises(Exception):
+                reg.predict("a", X)
+        finally:
+            _imod.InferenceModel.placement_complete = orig
+        # the refused rebuild left the entry COLD, counted as an error
+        assert reg._entries["a"].pager_state != "resident"
+        snap = reg.pager.snapshot()["models"]
+        assert snap["a"]["fault_error"] >= 1
+        # and the un-poisoned retry installs + serves bit-exactly
+        assert np.array_equal(np.asarray(reg.predict("a", X)), expected)
+        assert reg._entries["a"].active.model.placement_complete()
+
+
+def test_concurrent_fault_evict_churn_never_partial():
+    fn, params = _mlp_fn()
+    rng = np.random.default_rng(1)
+    params2 = {k: (v + rng.normal(size=v.shape).astype(np.float32) * 0.1)
+               for k, v in params.items()}
+    exp = {"a": np.asarray(jax.jit(fn)(params, X)),
+           "b": np.asarray(jax.jit(fn)(params2, X))}
+    with _paged_mesh_registry() as reg:
+        _deploy_mesh(reg, "a", fn, params)
+        _deploy_mesh(reg, "b", fn, params2)
+        errs, wrong = [], []
+
+        def hammer(name, n):
+            for _ in range(n):
+                try:
+                    out = np.asarray(reg.predict(name, X))
+                except Exception as e:  # noqa: BLE001 — gate counts
+                    errs.append(e)
+                    continue
+                if not np.array_equal(out, exp[name]):
+                    wrong.append(name)
+
+        ts = [threading.Thread(target=hammer, args=(n, 8))
+              for n in ("a", "b") for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errs and not wrong
+        snap = reg.pager.snapshot()["models"]
+        # at budget 1 the alternating load must actually churn
+        assert sum(m["fault_ok"] for m in snap.values()) >= 2
+        # whatever ended resident is FULLY placed (never partial)
+        for name in ("a", "b"):
+            entry = reg._entries[name]
+            if entry.pager_state == "resident":
+                assert entry.active.model.placement_complete()
+
+
+def test_undeploy_racing_group_fault_discards_rebuild():
+    import time as _time
+    fn, params = _mlp_fn()
+    with _paged_mesh_registry() as reg:
+        _deploy_mesh(reg, "a", fn, params)
+        _deploy_mesh(reg, "b", fn, params)
+        reg.predict("b", X)  # a cold
+        entry = reg._entries["a"]
+        real = entry.pager_recipe.build
+        started = threading.Event()
+        built = []
+
+        def slow_build(span=None):
+            started.set()
+            _time.sleep(0.4)
+            im = real(span=span)
+            built.append(im)
+            return im
+
+        entry.pager_recipe.build = slow_build
+        errs = []
+
+        def hit():
+            try:
+                reg.predict("a", X)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=hit)
+        t.start()
+        assert started.wait(timeout=10)
+        reg.undeploy("a", drain_timeout=0.1)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert len(errs) == 1 and isinstance(errs[0], ModelNotFound)
+        # the stale sharded rebuild was discarded on the generation
+        # check, not installed into the undeployed entry
+        assert len(built) == 1
+        assert entry.pager_state is None and entry.active is None
+
+
+# ------------------------------------------------------- sharded decode
+def test_decode_engine_mesh_bitexact():
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.pipeline.inference.decode import DecodeEngine
+    VOCAB, SEQ, BUCKET = 64, 48, 16
+    lm = TransformerLM(vocab_size=VOCAB, seq_len=SEQ, n_layers=2,
+                       d_model=32, n_heads=2)
+    lm.ensure_inference_ready()
+    lp = lm.trainer.state.params
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, VOCAB, int(rng.integers(4, BUCKET)))
+               for _ in range(3)]
+
+    def run(mesh):
+        eng = DecodeEngine(lp, lm.hyper, capacity=2, max_len=SEQ,
+                           prompt_buckets=(BUCKET,), mesh=mesh)
+        try:
+            streams = [eng.submit(p, max_new_tokens=5,
+                                  temperature=0.7, seed=i)
+                       for i, p in enumerate(prompts)]
+            return [list(s.result()) for s in streams]
+        finally:
+            eng.close()
+
+    assert run(None) == run({"axes": {"tensor": 2}})
+
+
+def test_decode_engine_mesh_rejects_unsupported():
+    from analytics_zoo_tpu.models import TransformerLM
+    from analytics_zoo_tpu.pipeline.inference.decode import DecodeEngine
+    lm = TransformerLM(vocab_size=64, seq_len=48, n_layers=2,
+                       d_model=32, n_heads=2)
+    lm.ensure_inference_ready()
+    lp = lm.trainer.state.params
+    with pytest.raises(ValueError):
+        DecodeEngine(lp, lm.hyper, capacity=3, max_len=48,
+                     prompt_buckets=(16,),
+                     mesh={"axes": {"tensor": 2}})  # 3 % 2 != 0
+    with pytest.raises(ValueError):
+        DecodeEngine(lp, lm.hyper, capacity=4, max_len=48,
+                     prompt_buckets=(16,), prefix_pool=2,
+                     mesh={"axes": {"tensor": 2}})
+    with pytest.raises(ValueError):
+        DecodeEngine(lp, lm.hyper, capacity=4, max_len=48,
+                     prompt_buckets=(16,),
+                     device=jax.local_devices()[0],
+                     mesh={"axes": {"tensor": 2}})
