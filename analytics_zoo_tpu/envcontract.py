@@ -1,0 +1,86 @@
+"""The ``ZOO_*`` environment-variable contract, in one place.
+
+Every environment variable the package reads under the ``ZOO_`` prefix
+is declared in :data:`VARS` and read through the accessors below —
+nothing else in the tree touches ``os.environ`` for a ``ZOO_*`` name.
+zoolint's ZL812 enforces the discipline statically (any scattered
+``os.environ`` read of a ``ZOO_*`` name outside this module is a
+finding), and ``zoolint contracts`` renders :data:`VARS` into the
+committed ``contracts_snapshot.json`` so adding a knob is an explicit
+reviewed hunk, with the docs tables in ``docs/serving.md`` /
+``docs/distributed-training.md`` kept in lockstep.
+
+Why centralize: before this module the reads were scattered across
+``train/faults.py``, ``observability/flightrec.py``, ``serving/fleet``
+and ``serving/execstore.py`` — renaming a variable (or auditing what a
+deployment may set) meant grepping, and two modules could silently
+disagree on parsing (int vs flag).  The accessors fix the parse
+semantics per call site and the table fixes the vocabulary.
+
+The legacy ``ENV_*`` module constants (``faults.ENV_RESUME``,
+``flightrec.ENV_DIR``, ...) remain as aliases for external callers;
+their values are the canonical names declared here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# name -> one-line purpose.  The single source of truth for the env
+# contract: the docs tables, the ZL812 rule, and the contracts
+# snapshot all derive from this dict.
+VARS: Dict[str, str] = {
+    "ZOO_TPU_COORDINATOR": "coordinator address for multi-process jax.distributed init",
+    "ZOO_TPU_NUM_PROCESSES": "process count for multi-process jax.distributed init",
+    "ZOO_TPU_PROCESS_ID": "this process's rank in the pod (also stamps logs/metrics)",
+    "ZOO_RESTART_COUNT": "supervisor-maintained incarnation counter for elastic restarts",
+    "ZOO_RESUME": "flag: this incarnation is a restart and must resume from checkpoint",
+    "ZOO_HEARTBEAT_FILE": "path the worker touches per step/loop for liveness detection",
+    "ZOO_CKPT_SYNC": "flag: force synchronous (blocking) checkpoint saves",
+    "ZOO_FAULT_CRASH_STEP": "fault drill: step at which the chosen rank hard-crashes",
+    "ZOO_FAULT_CRASH_RANK": "fault drill: rank that crashes at ZOO_FAULT_CRASH_STEP",
+    "ZOO_FAULT_HANG_STEP": "fault drill: step at which the chosen rank hangs",
+    "ZOO_FAULT_HANG_RANK": "fault drill: rank that hangs at ZOO_FAULT_HANG_STEP",
+    "ZOO_FAULT_CORRUPT_TAG": "fault drill: checkpoint tag to corrupt on save",
+    "ZOO_FLIGHTREC_DIR": "directory for flight-recorder ring dumps and post-mortems",
+    "ZOO_STEP_PROFILE": "flag: enable the per-step training profiler",
+    "ZOO_STEP_TIMELINE": "path for the step profiler's JSON timeline dump",
+    "ZOO_EXECSTORE_DIR": "root directory of the persistent executable store",
+    "ZOO_EXECSTORE_BYTES": "byte budget for the executable store's LRU eviction",
+    "ZOO_PAGER_RESIDENT": "worker pager residency budget (max resident models)",
+    "ZOO_FLEET_WIRE": "fleet wire encoding override: 'json' disables binary frames",
+    "ZOO_FLEET_MAX_FRAME": "max accepted fleet frame size in bytes (DoS guard)",
+}
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """The raw string value of a declared ``ZOO_*`` variable.
+
+    Empty values fall through to ``default`` — an exported-but-empty
+    variable means "unset" everywhere in this package.
+    """
+    if name not in VARS:
+        raise KeyError(f"undeclared env var {name!r}: add it to "
+                       "envcontract.VARS (and the docs table)")
+    return os.environ.get(name) or default
+
+
+def env_int(name: str, default: int = 0) -> int:
+    """Integer parse of a declared variable; unset/empty/garbage all
+    yield ``default`` (an operator typo must degrade, not crash a
+    worker at import)."""
+    raw = env_str(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def env_flag(name: str) -> bool:
+    """Truthiness of a declared variable: any non-empty value is on
+    (the historical ``bool(os.environ.get(...))`` semantics every
+    caller already relied on)."""
+    return env_str(name) is not None

@@ -60,6 +60,7 @@ import tempfile
 import time
 from typing import List, Optional, Tuple
 
+from . import envcontract
 from .observability import flightrec
 from .parallel.distributed import ENV_COORD, ENV_NPROC, ENV_PID
 from .train import faults
@@ -162,7 +163,7 @@ def _flight_dir(run_dir: str) -> str:
     """The pod's shared flight-recorder directory: a pre-set
     ``ZOO_FLIGHTREC_DIR`` wins (drills harvest it themselves),
     otherwise it lives with the other supervision artifacts."""
-    return (os.environ.get(flightrec.ENV_DIR)
+    return (envcontract.env_str(flightrec.ENV_DIR)
             or os.path.join(run_dir, "flightrec"))
 
 

@@ -58,6 +58,7 @@ from typing import (Any, Callable, Dict, Iterable, List, Optional,
 from . import log as log_mod
 from . import trace as trace_mod
 from .metrics import Family, process_info_family, render_prometheus
+from .. import envcontract
 
 #: shared pod directory; the supervising launcher exports this to every
 #: worker (a pre-set value wins, so drills can harvest it themselves)
@@ -78,7 +79,10 @@ def _env_int(*names: str) -> int:
     0 — telemetry identity must never crash a training job (same
     contract as log.refresh_identity)."""
     for name in names:
-        value = os.environ.get(name)
+        # ZOO_* names route through the declared contract; the JAX_*
+        # fallbacks are foreign and stay raw environ reads
+        value = (envcontract.env_str(name) if name in envcontract.VARS
+                 else os.environ.get(name))
         if value:
             try:
                 return int(value)
@@ -324,7 +328,7 @@ def install_from_env() -> "Optional[FlightRecorder]":
     it is not."""
     if _recorder is not None:
         return _recorder
-    base = os.environ.get(ENV_DIR)
+    base = envcontract.env_str(ENV_DIR)
     if not base:
         return None
     try:

@@ -35,6 +35,7 @@ import time
 from typing import Any, Dict, Optional
 
 from . import trace
+from .. import envcontract
 
 _LEVELS = {"debug": logging.DEBUG, "info": logging.INFO,
            "warning": logging.WARNING, "error": logging.ERROR,
@@ -58,9 +59,9 @@ def refresh_identity() -> None:
     ``train.faults.refresh`` so a supervisor-provided environment takes
     effect without import-order coupling)."""
     global _identity
-    rank = (os.environ.get("ZOO_TPU_PROCESS_ID")
+    rank = (envcontract.env_str("ZOO_TPU_PROCESS_ID")
             or os.environ.get("JAX_PROCESS_ID"))
-    incarnation = os.environ.get("ZOO_RESTART_COUNT")
+    incarnation = envcontract.env_str("ZOO_RESTART_COUNT")
     ident: Dict[str, int] = {}
     # tolerate empty/garbage values (a stale `export ZOO_RESTART_COUNT=`
     # must degrade to no stamp, never crash every log call)

@@ -29,6 +29,8 @@ from collections import deque
 from typing import (Any, Callable, Dict, Iterable, List, Optional,
                     Sequence, Tuple)
 
+from .. import envcontract
+
 
 # --------------------------------------------------------- primitives
 class LatencyWindow:
@@ -290,9 +292,9 @@ def process_info_family() -> Family:
     versions = _runtime_versions()
     labels = {
         "pid": str(os.getpid()),
-        "rank": os.environ.get("ZOO_TPU_PROCESS_ID")
+        "rank": envcontract.env_str("ZOO_TPU_PROCESS_ID")
         or os.environ.get("JAX_PROCESS_ID") or "0",
-        "incarnation": os.environ.get("ZOO_RESTART_COUNT") or "0",
+        "incarnation": envcontract.env_str("ZOO_RESTART_COUNT", "0"),
         "jax": versions["jax"],
         "jaxlib": versions["jaxlib"],
         "start_unix": str(_PROCESS_START_UNIX),

@@ -31,6 +31,8 @@ import logging
 import os
 from typing import Optional
 
+from .. import envcontract
+
 log = logging.getLogger("analytics_zoo_tpu")
 
 ENV_COORD = "ZOO_TPU_COORDINATOR"
@@ -42,9 +44,9 @@ _INITIALIZED = False
 
 def cluster_env_present() -> bool:
     """True when multi-process env vars are set (launcher or cloud)."""
-    return bool(os.environ.get(ENV_COORD)
+    return bool(envcontract.env_str(ENV_COORD)
                 or os.environ.get("JAX_COORDINATOR_ADDRESS")
-                or os.environ.get(ENV_NPROC)
+                or envcontract.env_str(ENV_NPROC)
                 or os.environ.get("JAX_NUM_PROCESSES"))
 
 
@@ -63,11 +65,12 @@ def maybe_initialize_distributed() -> bool:
         return False
     import jax
 
-    coord = (os.environ.get(ENV_COORD)
+    coord = (envcontract.env_str(ENV_COORD)
              or os.environ.get("JAX_COORDINATOR_ADDRESS"))
-    nproc = (os.environ.get(ENV_NPROC)
+    nproc = (envcontract.env_str(ENV_NPROC)
              or os.environ.get("JAX_NUM_PROCESSES"))
-    pid = (os.environ.get(ENV_PID) or os.environ.get("JAX_PROCESS_ID"))
+    pid = (envcontract.env_str(ENV_PID)
+           or os.environ.get("JAX_PROCESS_ID"))
     requested = os.environ.get("JAX_PLATFORMS", "").strip()
     if requested:
         # honor the launcher's platform choice explicitly — an installed

@@ -80,6 +80,18 @@ class ColdStartTimeout(ServingError):
     http_status = 503
 
 
+class WorkerUnavailable(ServingError):
+    """No live, routable worker could take the request (whole plane
+    restarting or dead).  503: back off and retry.
+
+    Raised by the fleet router; it lives here with the other serving
+    errors so the wire protocol can register it in its envelope
+    round-trip table without importing the router (zoolint ZL802 pins
+    the registration)."""
+
+    http_status = 503
+
+
 class DeployError(ServingError):
     """A deploy failed before the swap (build or warmup error).  The
     previously active version is untouched and keeps serving — this is

@@ -77,6 +77,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import envcontract
 from ..observability import trace as _trace
 from ..observability.log import get_logger as _get_logger
 from ..observability.metrics import Family
@@ -495,9 +496,9 @@ def current() -> Optional[ExecStore]:
         with _cur_lock:
             if _current is None and not _env_checked:
                 _env_checked = True
-                root = os.environ.get(ENV_DIR)
+                root = envcontract.env_str(ENV_DIR)
                 if root:
-                    budget = os.environ.get(ENV_BUDGET)
+                    budget = envcontract.env_str(ENV_BUDGET)
                     _current = ExecStore(
                         root,
                         byte_budget=int(budget) if budget else None)
@@ -534,7 +535,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_gc.add_argument("--budget", type=int, default=None,
                       help=f"byte budget (default: ${ENV_BUDGET})")
     args = parser.parse_args(argv)
-    root = args.root or os.environ.get(ENV_DIR)
+    root = args.root or envcontract.env_str(ENV_DIR)
     if not root:
         parser.error(f"no store: pass --root or set ${ENV_DIR}")
     store = ExecStore(root)
@@ -563,7 +564,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     budget = args.budget
     if budget is None:
-        env_budget = os.environ.get(ENV_BUDGET)
+        env_budget = envcontract.env_str(ENV_BUDGET)
         if env_budget is None:
             parser.error(f"gc needs --budget or ${ENV_BUDGET}")
         budget = int(env_budget)

@@ -59,6 +59,7 @@ import struct
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+from ... import envcontract
 from .. import errors as _errors
 
 _HEADER = struct.Struct("<II")  # payload length, CRC32(payload)
@@ -82,13 +83,8 @@ def max_frame_bytes() -> int:
     """The effective frame bound: ``ZOO_FLEET_MAX_FRAME`` (bytes) when
     set and parseable, else :data:`MAX_FRAME_BYTES`.  Read per call so
     a worker env override applies without plumbing."""
-    v = os.environ.get("ZOO_FLEET_MAX_FRAME")
-    if v:
-        try:
-            return int(v)
-        except ValueError:
-            pass
-    return MAX_FRAME_BYTES
+    v = envcontract.env_int("ZOO_FLEET_MAX_FRAME")
+    return v if v > 0 else MAX_FRAME_BYTES
 
 
 class FrameError(ConnectionError):
@@ -375,6 +371,11 @@ _ERROR_CLASSES = {
     # NEVER retried on a sibling (the router's rule), so one slow
     # fault cannot make every worker fault the same model
     "ColdStartTimeout": _errors.ColdStartTimeout,
+    # the router's own 503: without this entry a worker-raised (or
+    # proxied) WorkerUnavailable decoded on the client came back as a
+    # bare ServingError with http_status 500 — the isinstance retry
+    # rules and status mapping both lost the concrete class
+    "WorkerUnavailable": _errors.WorkerUnavailable,
 }
 
 

@@ -80,20 +80,13 @@ from ...observability import trace as _trace
 from ...observability.log import get_logger
 from ...observability.metrics import (Family, parse_prometheus_text,
                                       render_prometheus)
-from ..errors import ServingError
+from ..errors import ServingError, WorkerUnavailable
 from . import artifact, protocol
 from .supervisor import FleetSupervisor
 
 _slog = get_logger("zoo.serving.fleet.router")
 
 EXECSTORE_SUBDIR = "execstore"
-
-
-class WorkerUnavailable(ServingError):
-    """No live, routable worker could take the request (whole plane
-    restarting or dead).  503: back off and retry."""
-
-    http_status = 503
 
 
 class _Handle:
@@ -914,6 +907,15 @@ class FleetRouter:
                                   outstanding=h.outstanding)
                     break
                 time.sleep(0.01)
+            # cooperative shutdown first: the worker's serve loop has a
+            # "shutdown" handler for exactly this, and a worker that
+            # exits on its own skips the supervisor's terminate->kill
+            # escalation (retire() marks it "retired" before the exit
+            # lands, so the monitor never books it as an incident)
+            try:
+                self._call(h, {"op": "shutdown"})
+            except (ConnectionError, ServingError):
+                pass  # drain already emptied it; terminate() below wins
             h.drop_conns()
             h.port = None
             h.resident = frozenset()

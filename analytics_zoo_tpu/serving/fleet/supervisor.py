@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ... import envcontract
 from ...observability import flightrec
 from ...observability.log import get_logger
 
@@ -113,7 +114,7 @@ class FleetSupervisor:
         """Shared flight-recorder base: a pre-set outer
         ``ZOO_FLIGHTREC_DIR`` wins (drills harvest it themselves) —
         the launcher's convention."""
-        return (os.environ.get(flightrec.ENV_DIR)
+        return (envcontract.env_str(flightrec.ENV_DIR)
                 or os.path.join(self.run_dir, "flightrec"))
 
     def start(self) -> None:

@@ -60,6 +60,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ... import envcontract
 from ...observability import flightrec
 from ...observability.log import get_logger
 from ...observability.metrics import MetricsRegistry
@@ -94,7 +95,7 @@ class ServingWorker:
         rec = flightrec.current()
         if rec is not None:
             rec.add_collector(self.metrics.collect)
-        self._hb_path = os.environ.get("ZOO_HEARTBEAT_FILE")
+        self._hb_path = envcontract.env_str("ZOO_HEARTBEAT_FILE")
         self._hb_last = 0.0
         self._compile_events: List[str] = []
         self._compile_hooked = False
@@ -104,7 +105,7 @@ class ServingWorker:
         # binary-wire bug ever ships) — the worker still DECODES
         # either encoding regardless
         self.wire_max = (protocol.WIRE_JSON
-                         if os.environ.get("ZOO_FLEET_WIRE") == "json"
+                         if envcontract.env_str("ZOO_FLEET_WIRE") == "json"
                          else protocol.WIRE_BINARY)
         # load piggyback: serve-op in-flight count plus a throttled
         # residency snapshot, attached to every reply (and ping) so
@@ -415,7 +416,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     flightrec.install_from_env()
     reg_kwargs = json.loads(args.registry_json) if args.registry_json \
         else {}
-    pager_env = os.environ.get("ZOO_PAGER_RESIDENT")
+    pager_env = envcontract.env_str("ZOO_PAGER_RESIDENT")
     if pager_env and "pager" not in reg_kwargs:
         try:
             reg_kwargs["pager"] = {"max_resident": int(pager_env)}

@@ -27,6 +27,20 @@ Rule codes (catalog with rationale: docs/dev/zoolint.md):
                        (re-read instead of a local snapshot)
     ZL731              lock-order cycles in the global lexical
                        lock-acquisition graph
+    ZL801              wire ops sent without a handler (or handled
+                       without a sender); encode/decode key asymmetry
+    ZL802              ServingError subclasses that cannot round-trip
+                       the wire error envelope
+    ZL811              metric family schema conflicts and docs drift
+    ZL812              ZOO_* env reads outside the envcontract module
+    ZL821              compile-path config reads missing from the
+                       executable-store fingerprint
+
+v3 rules (ZL8xx) are cross-module: one :class:`ContractIndex` built
+over every file at once checks the agreements BETWEEN modules (wire
+ops, error envelopes, metric schemas, env knobs, fingerprint keys).
+``zoolint contracts`` renders the same index as a committed snapshot
+(``contracts_snapshot.json``) that CI diffs on every run.
 
 v2 rules run real dataflow: :mod:`cfg` builds a per-function CFG with
 explicit exception edges, :mod:`dataflow` iterates forward
@@ -53,12 +67,14 @@ from .dataflow import solve_forward
 from .engine import ALL_CODES, lint_paths
 from .findings import Finding
 from .hotpath import DEFAULT_HOT_ENTRIES
+from .rules_contracts import ContractIndex, rule_contracts
 
 __all__ = ["ALL_CODES", "BaselineError", "CATALOG", "CFG",
-           "DEFAULT_HOT_ENTRIES", "Finding", "InvariantLeakDetected",
-           "RecompileDetected", "SanitizeError", "SanitizeReport",
-           "apply_baseline", "build_cfg", "explain", "lint_paths",
-           "load_baseline", "render_baseline", "sanitize",
+           "ContractIndex", "DEFAULT_HOT_ENTRIES", "Finding",
+           "InvariantLeakDetected", "RecompileDetected",
+           "SanitizeError", "SanitizeReport", "apply_baseline",
+           "build_cfg", "explain", "lint_paths", "load_baseline",
+           "render_baseline", "rule_contracts", "sanitize",
            "solve_forward"]
 
 

@@ -21,6 +21,7 @@ _FAMILY_ANCHORS = {
     "5": "#zl5xx--thread-lifecycle",
     "6": "#zl6xx--observability-discipline-hot-path-call-graph-based",
     "7": "#zl7xx--exception-path-dataflow-rules-v2",
+    "8": "#zl8xx--distributed-contract-rules-v3",
 }
 
 CATALOG: Dict[str, Dict[str, str]] = {
@@ -219,6 +220,85 @@ CATALOG: Dict[str, Dict[str, str]] = {
                 "        with self._cond: ...\n"
                 "def b(self):\n    with self._lock:\n"
                 "        with self._cond: ...",
+    },
+    "ZL801": {
+        "title": "wire op without a peer (or asymmetric codec keys)",
+        "rationale": "The router's send sites and the worker's "
+                     "dispatch table are the two halves of one "
+                     "protocol, usually edited in different files.  "
+                     "An op sent with no handler is an unknown-op "
+                     "error on the first real call; a handler nothing "
+                     "sends is dead surface that rots unseen; a "
+                     "decode_X reading a key its encode_X never "
+                     "writes is a KeyError on the first real frame.",
+        "bad": "conn.send({\"op\": \"flush\", \"id\": rid})\n"
+               "# worker: self._control = {\"predict\": ...}  # no flush",
+        "good": "conn.send({\"op\": \"flush\", \"id\": rid})\n"
+                "# worker: self._control = {\"predict\": ...,\n"
+                "#                          \"flush\": self._flush}",
+    },
+    "ZL802": {
+        "title": "error class that cannot round-trip the wire",
+        "rationale": "decode_error rebuilds worker exceptions from "
+                     "the registry keyed by class name.  A "
+                     "ServingError subclass missing from it decodes "
+                     "as the bare base — wrong http_status, wrong "
+                     "isinstance retry class on the client.  Same "
+                     "for a duplicate class name (one wire code, two "
+                     "meanings), a missing http_status, or an "
+                     "__init__ that cannot absorb cls(msg, **details).",
+        "bad": "class WorkerUnavailable(ServingError):\n"
+               "    http_status = 503\n"
+               "_ERROR_CLASSES = {\"Overloaded\": Overloaded}",
+        "good": "_ERROR_CLASSES = {\"Overloaded\": Overloaded,\n"
+                "    \"WorkerUnavailable\": WorkerUnavailable}",
+    },
+    "ZL811": {
+        "title": "metric family schema conflict or docs drift",
+        "rationale": "The pod aggregator and every dashboard key on "
+                     "family name, type, and label schema — a name "
+                     "declared as counter here and gauge there "
+                     "merges apples into oranges; a *_total gauge "
+                     "breaks every rate(); a rank label collides "
+                     "with the aggregator's own stamping; a family "
+                     "absent from docs/observability.md (or "
+                     "documented but never emitted) is operator-"
+                     "contract drift.",
+        "bad": "Family(\"counter\", \"fx_requests_total\", \"..\")\n"
+               "# elsewhere:\n"
+               "Family(\"gauge\", \"fx_requests_total\", \"..\")",
+        "good": "Family(\"counter\", \"fx_requests_total\", \"..\")\n"
+                "# one name, one type, everywhere",
+    },
+    "ZL812": {
+        "title": "ZOO_* env read outside the env contract",
+        "rationale": "A knob read wherever os.environ is handy has "
+                     "no declaration, no docs row, and no snapshot "
+                     "diff when it changes.  Every ZOO_* read goes "
+                     "through envcontract.env_str/env_int/env_flag, "
+                     "whose VARS table is the single declaration "
+                     "point (and must stay documented).",
+        "bad": "limit = os.environ.get(\"ZOO_FAKE_LIMIT\")",
+        "good": "from analytics_zoo_tpu import envcontract\n"
+                "limit = envcontract.env_str(\"ZOO_FAKE_LIMIT\")\n"
+                "# + a VARS entry and a docs table row",
+    },
+    "ZL821": {
+        "title": "config read on the compile path, not in the key",
+        "rationale": "The executable store replays compiles by "
+                     "fingerprint.  A constructor-derived config "
+                     "attribute that the compile-reachable path "
+                     "reads but the fingerprint never folds means "
+                     "two deploys differing only in that knob share "
+                     "a key — the second serves the first's STALE "
+                     "executable.  Fold the attr (or a canonical "
+                     "digest of it) into the fingerprint extras.",
+        "bad": "def _shape(self, n):\n"
+               "    return n * self._pad_mult  # read, not folded\n"
+               "def ensure(self, n):\n"
+               "    fp = self.store.fingerprint(\"kind\", self._dg)",
+        "good": "fp = self.store.fingerprint(\"kind\", self._dg,\n"
+                "                            self._pad_mult)",
     },
 }
 

@@ -19,6 +19,7 @@ from .rules_concurrency import (rule_blocking_under_lock,
                                 rule_lock_order,
                                 rule_thread_lifecycle,
                                 rule_unbounded_queue)
+from .rules_contracts import rule_contracts
 from .rules_donation import rule_use_after_donate
 from .rules_jax import rule_recompile, rule_tracer_leaks, \
     rule_unhashable_static
@@ -39,7 +40,8 @@ MODULE_RULES: Tuple[Callable[[ModuleContext], List[Finding]], ...] = (
 #: every rule code zoolint can emit (docs + fixture tests key off this)
 ALL_CODES = ("ZL101", "ZL102", "ZL103", "ZL201", "ZL202", "ZL203",
              "ZL301", "ZL302", "ZL401", "ZL402", "ZL501", "ZL502",
-             "ZL601", "ZL701", "ZL702", "ZL711", "ZL721", "ZL731")
+             "ZL601", "ZL701", "ZL702", "ZL711", "ZL721", "ZL731",
+             "ZL801", "ZL802", "ZL811", "ZL812", "ZL821")
 
 
 def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
@@ -89,5 +91,9 @@ def lint_paths(paths: Sequence[str], root: Optional[str] = None,
     # global lock-acquisition graph both need every module at once
     findings.extend(rule_check_then_deref(ctxs))
     findings.extend(rule_lock_order(ctxs))
+    # v3 distributed-contract pass: one ContractIndex over every
+    # module, five ZL8xx families off it (root locates the docs that
+    # the drift checks audit against)
+    findings.extend(rule_contracts(ctxs, root=root))
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings

@@ -53,6 +53,8 @@ import os
 import time
 from typing import Optional
 
+from .. import envcontract
+
 ENV_RESUME = "ZOO_RESUME"
 ENV_RESTART_COUNT = "ZOO_RESTART_COUNT"
 ENV_HEARTBEAT = "ZOO_HEARTBEAT_FILE"
@@ -74,16 +76,16 @@ _hang_step: Optional[int] = None
 
 
 def _rank() -> int:
-    return int(os.environ.get("ZOO_TPU_PROCESS_ID")
+    return int(envcontract.env_str("ZOO_TPU_PROCESS_ID")
                or os.environ.get("JAX_PROCESS_ID") or 0)
 
 
 def resume_requested() -> bool:
-    return bool(os.environ.get(ENV_RESUME))
+    return envcontract.env_flag(ENV_RESUME)
 
 
 def sync_checkpoints() -> bool:
-    return bool(os.environ.get(ENV_CKPT_SYNC))
+    return envcontract.env_flag(ENV_CKPT_SYNC)
 
 
 def refresh() -> None:
@@ -91,7 +93,7 @@ def refresh() -> None:
     a supervisor-provided environment — or a test's monkeypatch — takes
     effect without import-order coupling)."""
     global _hb_path, _crash_step, _hang_step
-    _hb_path = os.environ.get(ENV_HEARTBEAT) or None
+    _hb_path = envcontract.env_str(ENV_HEARTBEAT)
     _crash_step = None
     _hang_step = None
     # the structured logger stamps rank/incarnation from the same env
@@ -101,11 +103,11 @@ def refresh() -> None:
     if resume_requested():
         return  # fault hooks are one-shot: disarmed on a resumed pod
     rank = _rank()
-    step = os.environ.get(ENV_CRASH_STEP)
-    if step and rank == int(os.environ.get(ENV_CRASH_RANK) or 1):
+    step = envcontract.env_str(ENV_CRASH_STEP)
+    if step and rank == envcontract.env_int(ENV_CRASH_RANK, 1):
         _crash_step = int(step)
-    step = os.environ.get(ENV_HANG_STEP)
-    if step and rank == int(os.environ.get(ENV_HANG_RANK) or 1):
+    step = envcontract.env_str(ENV_HANG_STEP)
+    if step and rank == envcontract.env_int(ENV_HANG_RANK, 1):
         _hang_step = int(step)
 
 
@@ -146,7 +148,7 @@ def maybe_corrupt_shard(directory: str, tag) -> None:
     wrong one."""
     if resume_requested():
         return
-    want = os.environ.get(ENV_CORRUPT_TAG)
+    want = envcontract.env_str(ENV_CORRUPT_TAG)
     if not want or str(tag) != want:
         return
     path = os.path.join(directory, f"ckpt_{tag}.shard-p0.npz")

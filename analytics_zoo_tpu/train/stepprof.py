@@ -42,6 +42,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from .. import envcontract
 from ..observability.metrics import (Family, LatencyWindow,
                                      summary_family)
 from ..observability.trace import TRAIN_PHASES, Span
@@ -55,11 +56,11 @@ _FLUSH_EVERY = 32
 
 def from_env() -> "Optional[StepProfiler]":
     """A profiler per the env contract, or None when not requested."""
-    if not os.environ.get(ENV_PROFILE) \
-            and not os.environ.get(ENV_TIMELINE):
+    if not envcontract.env_flag(ENV_PROFILE) \
+            and not envcontract.env_flag(ENV_TIMELINE):
         return None
     return StepProfiler(
-        timeline_path=os.environ.get(ENV_TIMELINE) or None)
+        timeline_path=envcontract.env_str(ENV_TIMELINE))
 
 
 class StepProfiler:

@@ -51,4 +51,18 @@ PY
 if [ "$rc" -ne 0 ]; then
     exit "$rc"
 fi
+# committed-contract gate: the live ContractIndex (wire ops, error
+# codes, env vars, metric families) must match contracts_snapshot.json
+# — a protocol change that never touched the snapshot never got its
+# diff reviewed.  Drift: `zoolint contracts --update` + commit.
+if env JAX_PLATFORMS=cpu python -m analytics_zoo_tpu.tools.zoolint \
+    contracts --check > /dev/null; then
+    echo "zoolint summary: contracts=ok"
+else
+    crc=$?
+    echo "zoolint summary: contracts=drift"
+    env JAX_PLATFORMS=cpu python -m analytics_zoo_tpu.tools.zoolint \
+        contracts --check || true
+    exit "$crc"
+fi
 echo "zoolint OK"
