@@ -51,6 +51,8 @@ VARS: Dict[str, str] = {
     "ZOO_PAGER_RESIDENT": "worker pager residency budget (max resident models)",
     "ZOO_FLEET_WIRE": "fleet wire encoding override: 'json' disables binary frames",
     "ZOO_FLEET_MAX_FRAME": "max accepted fleet frame size in bytes (DoS guard)",
+    "ZOO_TRACE_TAIL_Q": "tail-sampling retention quantile in (0,1) for exemplar traces (default 0.95; out-of-range disables)",
+    "ZOO_TRACE_TAIL_CAP": "max tail-retained exemplar span trees per process (default 64)",
 }
 
 

@@ -85,6 +85,24 @@ def iter_snapshots(base_dir: str
     return out
 
 
+def rank_labeled(fams: Iterable[Family], rank: Any) -> List[Family]:
+    """Stamp ``rank`` on every sample missing one — how a non-worker
+    process (the fleet router scraping its own tracer) joins a pod
+    exposition without colliding with either the per-rank worker
+    series or the rank-less counter pod totals this module emits.
+    A sample that already carries a rank keeps it (the snapshot's own
+    labeling wins, same rule as :func:`merge_snapshots`)."""
+    out: List[Family] = []
+    for fam in fams:
+        samples: List[Tuple] = []
+        for s in fam.samples:
+            labels = dict(s[0])
+            labels.setdefault("rank", str(rank))
+            samples.append((labels, *s[1:]))
+        out.append(Family(fam.mtype, fam.name, fam.help, samples))
+    return out
+
+
 def _base_family(name: str, types: Dict[str, str]) -> Tuple[str, str]:
     """Resolve a sample name to its (family name, type): summary
     ``_sum``/``_count`` samples belong to their base family."""

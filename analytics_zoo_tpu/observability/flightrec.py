@@ -156,10 +156,19 @@ class FlightRecorder:
 
     # ------------------------------------------------------- write side
     def _write_meta(self) -> None:
+        start_unix = time.time()
         meta: Dict[str, Any] = {
             "pid": os.getpid(), "rank": self.rank,
             "incarnation": self.incarnation,
-            "start_unix": round(time.time(), 6)}
+            "start_unix": round(start_unix, 6),
+            # wall/monotonic anchor, sampled back-to-back: spans
+            # record perf_counter starts, so anchor.unix +
+            # (span.start_mono_s - anchor.mono) places any of this
+            # incarnation's spans on the wall clock with ONE
+            # correction per rank — the trace stitcher's clock
+            # alignment (observability/tracefleet.py)
+            "anchor": {"unix": round(start_unix, 6),
+                       "mono": round(time.perf_counter(), 6)}}
         try:
             import jax
             import jaxlib

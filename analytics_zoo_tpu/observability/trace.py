@@ -41,6 +41,7 @@ import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from .. import envcontract
 from .metrics import Family
 
 #: the canonical request phase order (docs/observability.md).  After
@@ -131,13 +132,45 @@ def set_finish_hook(fn) -> None:
     _FINISH_HOOK = fn
 
 
+# decoder for compact wire-string children (set by tracefleet on
+# import — the module that owns the wire format): Span.to_dict uses
+# it to render raw nested strings as summary dicts.  Returning None
+# for a given string drops that child from the serialized form.
+_CHILD_DECODER: "Optional[Any]" = None
+
+
+def set_child_decoder(fn) -> None:
+    global _CHILD_DECODER
+    _CHILD_DECODER = fn
+
+
+def tail_config_from_env() -> Dict[str, Any]:
+    """Tail-sampling Tracer kwargs from the env contract:
+    ``ZOO_TRACE_TAIL_Q`` (retention quantile, default 0.95; a value
+    outside (0,1) — e.g. an explicit ``0`` — disables retention) and
+    ``ZOO_TRACE_TAIL_CAP`` (exemplar budget, default 64).  Garbage
+    degrades to the defaults, the envcontract parsing discipline."""
+    cap = envcontract.env_int("ZOO_TRACE_TAIL_CAP", 64)
+    raw = envcontract.env_str("ZOO_TRACE_TAIL_Q")
+    q: Optional[float] = 0.95
+    if raw is not None:
+        try:
+            q = float(raw)
+        except ValueError:
+            q = 0.95
+        if not (0.0 < q < 1.0):
+            q = None
+    return {"tail_quantile": q, "tail_cap": max(cap, 1)}
+
+
 class Span:
     """One request's timeline: ordered phases + point events + labels.
 
     Single-owner-at-a-time by design (see module doc) — no lock."""
 
     __slots__ = ("name", "trace_id", "labels", "start_s", "start_wall",
-                 "end_s", "phases", "events", "_open", "_tracer")
+                 "end_s", "phases", "events", "children", "_open",
+                 "_tracer", "_totals")
 
     def __init__(self, tracer: "Optional[Tracer]", name: str,
                  trace_id: Optional[str] = None,
@@ -154,7 +187,11 @@ class Span:
         # each entry: [phase_name, start, end_or_None]
         self.phases: List[List[Any]] = []
         self.events: List[Dict[str, Any]] = []
+        # remote child summaries (add_child); None until the first one
+        # lands — almost no span has children, so no list allocation
+        self.children: Optional[List[Dict[str, Any]]] = None
         self._open: Optional[List[Any]] = None
+        self._totals: Optional[Dict[str, float]] = None
 
     # ---- phases ----
     def phase_start(self, name: str):
@@ -202,6 +239,19 @@ class Span:
     def set_label(self, key: str, value: Any):
         self.labels[key] = value
 
+    def add_child(self, child):
+        """Nest a REMOTE span summary under this span — the fleet
+        router attaches the worker-side timeline a reply piggybacked
+        (tracefleet.py owns the summary shape and the stitching).  A
+        child is either a summary dict or the RAW compact wire string
+        it arrived as: the string is stored un-parsed — one object —
+        and only decoded when the span is serialized, because parsing
+        per request allocated enough to show up as gc pauses against
+        the traced-throughput gate."""
+        if self.children is None:
+            self.children = []
+        self.children.append(child)
+
     # ---- lifecycle ----
     def finish(self):
         """Close the open phase, stamp the end, and hand the span to
@@ -221,12 +271,20 @@ class Span:
 
     def phase_totals(self) -> Dict[str, float]:
         """Total seconds per phase name (a phase may recur, e.g. pad /
-        execute once per chunk of an oversized batch)."""
+        execute once per chunk of an oversized batch).  Memoized once
+        the span is finished — the serve path reads it twice per
+        request (ring aggregation, then the fleet-gap computation) and
+        the rebuild showed up against the traced-throughput gate.
+        Treat the returned dict as read-only."""
+        if self._totals is not None:
+            return self._totals
         out: Dict[str, float] = {}
         for name, t0, t1 in self.phases:
             if t1 is None:
                 continue
             out[name] = out.get(name, 0.0) + (t1 - t0)
+        if self.end_s is not None:
+            self._totals = out
         return out
 
     @property
@@ -242,11 +300,15 @@ class Span:
         return (self.phase_total_s / wall) if wall > 0 else 1.0
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "trace_id": self.trace_id,
             "name": self.name,
             "labels": dict(self.labels),
             "start_unix_s": round(self.start_wall, 6),
+            # the monotonic start too: paired with the recorder's
+            # meta.json wall/mono anchor it places this span on the
+            # pod timeline without trusting the wall clock per span
+            "start_mono_s": round(self.start_s, 6),
             "wall_ms": round(self.wall_s * 1e3, 4),
             "phases": [{"name": n,
                         "start_ms": round((t0 - self.start_s) * 1e3, 4),
@@ -257,6 +319,17 @@ class Span:
             "coverage": round(self.coverage, 4),
             "events": list(self.events),
         }
+        if self.children:
+            dec = _CHILD_DECODER
+            kids = []
+            for ch in self.children:
+                if isinstance(ch, str):
+                    ch = dec(ch) if dec is not None else None
+                    if ch is None:
+                        continue
+                kids.append(ch)
+            out["children"] = kids
+        return out
 
 
 class Tracer:
@@ -266,15 +339,41 @@ class Tracer:
     One tracer per serving process is the expected shape; the registry
     and the web frontend share it.  ``capacity`` bounds memory: the ring
     holds the most recent N finished spans, aggregates are O(#phases).
+
+    Tail sampling (``tail_quantile``): the ring treats every span
+    equally and washes the interesting ones out under load, so the
+    tracer additionally RETAINS full span trees for exactly the
+    requests worth a postmortem — every errored span, plus spans whose
+    wall time clears the running ``tail_quantile`` of recent walls —
+    in a store bounded by ``tail_cap`` (fastest non-errored exemplar
+    evicted first).  ``exemplars()`` lists them and ``families()``
+    publishes each as a ``zoo_trace_exemplar_ms`` sample whose
+    ``trace_id`` label is the join key the tracefleet stitcher
+    reconstructs a cross-process waterfall from.
     """
 
-    def __init__(self, capacity: int = 256):
+    #: recent-wall reservoir size and threshold refresh period for the
+    #: tail sampler: sorting 256 floats every finish showed up against
+    #: sub-ms requests, so the quantile threshold refreshes every 32
+    #: finishes instead — exemplar selection is a sieve, not a ruling
+    _TAIL_WINDOW = 256
+    _TAIL_REFRESH = 32
+
+    def __init__(self, capacity: int = 256,
+                 tail_quantile: Optional[float] = None,
+                 tail_cap: int = 64):
         self.capacity = int(capacity)
         self._ring: "deque[Span]" = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         # phase -> [count, total_s, max_s]
         self._agg: Dict[str, List[float]] = {}
         self._span_count = 0
+        # tail-sampled exemplar store: trace_id -> retained Span
+        self.tail_quantile = tail_quantile
+        self.tail_cap = max(int(tail_cap), 1)
+        self._tail: Dict[str, Span] = {}
+        self._tail_walls: "deque[float]" = deque(maxlen=self._TAIL_WINDOW)
+        self._tail_thr: Optional[float] = None
 
     def start_span(self, name: str = "request",
                    trace_id: Optional[str] = None,
@@ -311,12 +410,43 @@ class Tracer:
                     agg[0] += 1
                     agg[1] += dur
                     agg[2] = max(agg[2], dur)
+            if self.tail_quantile is not None:
+                self._tail_sample(span)
         hook = _FINISH_HOOK  # outside the lock: the hook does file I/O
         if hook is not None:
             try:
                 hook(span)
             except Exception:
                 pass  # the flight recorder must never fail a request
+
+    def _tail_sample(self, span: Span) -> None:
+        """Retention decision for one finished span (caller holds the
+        lock).  Errored spans always stay; otherwise the span's wall
+        must clear the cached quantile threshold of recent walls."""
+        wall = span.wall_s
+        walls = self._tail_walls
+        walls.append(wall)
+        if self._tail_thr is None \
+                or self._span_count % self._TAIL_REFRESH == 0:
+            ws = sorted(walls)
+            idx = min(int(len(ws) * self.tail_quantile), len(ws) - 1)
+            self._tail_thr = ws[idx]
+        if "error" not in span.labels and wall < self._tail_thr:
+            return
+        self._tail[span.trace_id] = span
+        while len(self._tail) > self.tail_cap:
+            victim = None
+            fastest = None
+            for tid, s in self._tail.items():
+                if "error" in s.labels:
+                    continue
+                w = s.wall_s
+                if fastest is None or w < fastest:
+                    fastest, victim = w, tid
+            if victim is None:
+                # every exemplar errored: oldest insertion goes
+                victim = next(iter(self._tail))
+            del self._tail[victim]
 
     # ---- read side ----
     @property
@@ -335,12 +465,23 @@ class Tracer:
             spans = spans[-n:] if n > 0 else []
         return [s.to_dict() for s in spans]
 
-    def find(self, trace_id: str) -> Optional[Dict[str, Any]]:
+    def find_span(self, trace_id: str) -> "Optional[Span]":
+        """The finished :class:`Span` object itself (ring newest-first,
+        then the tail store) — the allocation-free lookup the worker's
+        reply piggyback uses on the hot serve path; most callers want
+        :meth:`find`, which returns the serialized dict."""
         with self._lock:
             for s in reversed(self._ring):
                 if s.trace_id == trace_id:
-                    return s.to_dict()
-        return None
+                    return s
+            return self._tail.get(trace_id)
+
+    def find(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """Ring first (newest wins), then the tail-exemplar store —
+        an exemplar ``trace_id`` read off a scrape stays resolvable
+        long after the ring washed the span out."""
+        s = self.find_span(trace_id)
+        return s.to_dict() if s is not None else None
 
     def retire(self, **labels: Any) -> int:
         """Drop finished spans whose labels match ALL of ``labels``
@@ -359,6 +500,13 @@ class Tracer:
             if dropped:
                 self._ring.clear()
                 self._ring.extend(kept)
+            # exemplars pin spans too — a retired model's must go
+            # (not counted: the return value is ring spans dropped,
+            # and a span can sit in both structures at once)
+            for tid in [tid for tid, s in self._tail.items()
+                        if all(s.labels.get(k) == v
+                               for k, v in labels.items())]:
+                del self._tail[tid]
         return dropped
 
     def phase_stats(self) -> Dict[str, Dict[str, float]]:
@@ -370,11 +518,24 @@ class Tracer:
                             "max_ms": round(mx * 1e3, 4)}
                     for phase, (c, total, mx) in sorted(self._agg.items())}
 
+    def exemplars(self) -> List[Dict[str, Any]]:
+        """The tail-retained exemplar index: one row per retained span
+        tree, newest-insertion last.  ``kind`` is ``error`` or
+        ``slow``; the full tree is ``find(trace_id)``."""
+        with self._lock:
+            spans = list(self._tail.values())
+        return [{"trace_id": s.trace_id,
+                 "kind": "error" if "error" in s.labels else "slow",
+                 "model": str(s.labels.get("model", "")),
+                 "wall_ms": round(s.wall_s * 1e3, 4)}
+                for s in spans]
+
     def families(self) -> List[Family]:
         """Prometheus collector (plug into MetricsRegistry)."""
         with self._lock:
             agg = {k: list(v) for k, v in self._agg.items()}
             count = self._span_count
+            tail = list(self._tail.values())
         fams = [Family("counter", "zoo_trace_spans_total",
                        "finished request spans",
                        [({}, count)])]
@@ -386,4 +547,18 @@ class Tracer:
             "counter", "zoo_trace_phase_count_total",
             "phase occurrences across finished spans",
             [({"phase": p}, v[0]) for p, v in sorted(agg.items())]))
+        if tail:
+            # the exemplar link: a scrape row whose trace_id label
+            # names a span tree this process still holds in full —
+            # cardinality is bounded by tail_cap, and the stitcher
+            # (tracefleet.py) turns the id into a pod waterfall
+            fams.append(Family(
+                "gauge", "zoo_trace_exemplar_ms",
+                "tail-sampled exemplar traces (slowest-quantile and "
+                "errored requests): wall ms, joined on trace_id",
+                [({"model": str(s.labels.get("model", "")),
+                   "kind": ("error" if "error" in s.labels
+                            else "slow"),
+                   "trace_id": s.trace_id},
+                  round(s.wall_s * 1e3, 4)) for s in tail]))
         return fams

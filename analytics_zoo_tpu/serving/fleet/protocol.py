@@ -23,7 +23,14 @@ The payload is the existing control-plane envelope verbatim:
   ``ping``, ``shutdown``);
 * responses — ``{"id", "ok": true, "result", "info"}`` on success, or
   ``{"id", "ok": false, "error": <ServingError.to_dict()>}`` on
-  failure.  :func:`decode_error` reconstructs the CONCRETE serving
+  failure.  Every reply additionally piggybacks worker state: ``load``
+  (serve-op in-flight count + throttled residency snapshot, feeding
+  the router's affinity view for free) and — only when the request
+  carried a ``trace_id`` — ``trace``, a compact summary of the
+  worker-side span (closed phases, coverage, labels, wall/monotonic
+  start anchors, rank/incarnation) that the router nests under its
+  own ``worker_call`` phase; ``observability/tracefleet.py`` owns the
+  summary shape and the stitching.  :func:`decode_error` reconstructs the CONCRETE serving
   exception class on the client side — an ``Overloaded(evicted=True)``
   raised in a worker is an ``Overloaded`` with ``evicted=True`` in the
   router's caller, details, http_status and all.

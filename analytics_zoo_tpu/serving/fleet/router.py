@@ -77,6 +77,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ...observability import aggregate as _aggregate
 from ...observability import trace as _trace
+from ...observability import tracefleet
 from ...observability.log import get_logger
 from ...observability.metrics import (Family, parse_prometheus_text,
                                       render_prometheus)
@@ -507,7 +508,12 @@ class FleetRouter:
             span.set_label("worker", h.rank)
             span.phase_start("worker_call")
         try:
-            return self._call(h, req)
+            resp = self._call(h, req)
+            if span is not None:
+                # inline stitch: nest the worker's piggybacked span
+                # summary under this worker_call occurrence
+                tracefleet.nest_summary(span, resp.get("trace"))
+            return resp
         except ConnectionError:
             h.routable = False
             h.drop_conns()
@@ -518,11 +524,19 @@ class FleetRouter:
                           op=req.get("op"))
             if span is not None:
                 span.set_label("retried", True)
+                # the sibling leg is its OWN worker_call occurrence:
+                # the stitcher attributes the failed leg (no reply,
+                # no worker record) to the first occurrence and the
+                # served leg to this one
+                span.phase_start("worker_call")
             h2 = self._pick(exclude=h.rank, model=model, count=False)
             if span is not None:
                 span.set_label("worker", h2.rank)
             try:
-                return self._call(h2, req)
+                resp = self._call(h2, req)
+                if span is not None:
+                    tracefleet.nest_summary(span, resp.get("trace"))
+                return resp
             finally:
                 self._release(h2)
         finally:
@@ -612,6 +626,12 @@ class FleetRouter:
         info = dict(resp.get("info") or {})
         if span is not None:
             info["request_id"] = span.trace_id
+            if span.children:
+                # the per-request wire+queue remainder: worker_call
+                # time the nested worker legs do NOT account for
+                gap = tracefleet.inline_gap_ms(span)
+                if gap is not None:
+                    info["fleet_gap_ms"] = gap
         return protocol.decode_value(resp.get("result")), info
 
     # ---- cross-process coalescing ----
@@ -978,6 +998,13 @@ class FleetRouter:
                           parse_prometheus_text(resp["result"]["text"])))
         fams = _aggregate.merge_snapshots(pairs)
         fams.extend(self.families())
+        if self.tracer is not None:
+            # the router's own trace families (span/phase aggregates
+            # plus tail exemplar links) join the pod exposition under
+            # rank="router" — distinct from every worker's rank label
+            # AND from the aggregator's rank-less counter pod totals
+            fams.extend(_aggregate.rank_labeled(
+                self.tracer.families(), "router"))
         return render_prometheus(fams)
 
     def states(self) -> Dict[str, int]:

@@ -61,7 +61,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ... import envcontract
-from ...observability import flightrec
+from ...observability import flightrec, tracefleet
+from ...observability import trace as trace_mod
 from ...observability.log import get_logger
 from ...observability.metrics import MetricsRegistry
 from .. import execstore
@@ -86,9 +87,21 @@ class ServingWorker:
         # names this process's recorder directory and log stamps
         self.rank = flightrec._env_rank()
         self.incarnation = flightrec._env_incarnation()
-        self.registry = ModelRegistry(**(registry_kwargs or {}))
+        # every worker traces: finished registry spans land in the
+        # flight recorder (the configure() finish hook), tail-sampled
+        # exemplars in the tracer's bounded store, and a traced
+        # request's reply piggybacks its span summary back to the
+        # router (reply_trace in _handle).  setdefault: registry_json
+        # is parsed JSON and can never carry a live tracer, but a
+        # caller constructing in-process may
+        self.tracer = trace_mod.Tracer(
+            capacity=512, **trace_mod.tail_config_from_env())
+        reg_kwargs = dict(registry_kwargs or {})
+        reg_kwargs.setdefault("tracer", self.tracer)
+        self.registry = ModelRegistry(**reg_kwargs)
         self.metrics = MetricsRegistry()
         self.metrics.register_collector(registry_collector(self.registry))
+        self.metrics.register_collector(self.tracer.families)
         store = None if fake else execstore.current()
         if store is not None:
             self.metrics.register_collector(store.families)
@@ -309,7 +322,7 @@ class ServingWorker:
             # (binary hoists them out-of-band; JSON b64s them) — a
             # pre-encoded __nd__ dict would ride the binary wire as
             # base64 TEXT and throw the savings away
-            return {"result": out, "info": info}
+            return self._serve_result(out, info, req.get("trace_id"))
         if op == "generate":
             prompts = protocol.decode_value(req["prompt_ids"])
             # sampling params cross the wire as json scalars; the same
@@ -325,11 +338,26 @@ class ServingWorker:
                 temperature=req.get("temperature", 0.0),
                 top_k=req.get("top_k"), top_p=req.get("top_p"),
                 seed=req.get("seed", 0))
-            return {"result": out, "info": info}
+            return self._serve_result(out, info, req.get("trace_id"))
         fn = self._control.get(op)
         if fn is None:
             raise ValueError(f"unknown op {op!r}")
         return fn(req)
+
+    def _serve_result(self, out, info, trace_id) -> Dict[str, Any]:
+        """Package a serve-op result, piggybacking the worker-side
+        span summary when the request carried a ``trace_id`` — the
+        trace twin of the ``load`` residency piggyback, so the router
+        stitches the worker timeline under its ``worker_call`` with
+        no extra round trip.  Untraced requests pay one None check."""
+        resp: Dict[str, Any] = {"result": out, "info": info}
+        if trace_id is not None:
+            t = tracefleet.reply_trace(self.tracer, trace_id,
+                                       rank=self.rank,
+                                       inc=self.incarnation)
+            if t is not None:
+                resp["trace"] = t
+        return resp
 
     def _promote(self, req: Dict[str, Any]) -> Dict[str, Any]:
         return {"result": {"version": self.registry.promote(

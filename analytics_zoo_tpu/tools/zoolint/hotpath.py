@@ -101,7 +101,15 @@ DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        # on the request path — a stray sync or free-
                        # text log in either taxes every sharded
                        # request
-                       "placement_complete", "span_labels")
+                       "placement_complete", "span_labels",
+                       # distributed tracing: the worker-side reply
+                       # piggyback builder runs once per TRACED serve
+                       # reply and the router-side inline stitch once
+                       # per traced response — both sit inside the
+                       # traced/untraced throughput-ratio gate, so a
+                       # stray sync or free-text log in either is
+                       # exactly the overhead the gate bounds
+                       "reply_trace", "nest_summary")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

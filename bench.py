@@ -4621,7 +4621,14 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
       requests, no postmortem;
     * **residency affinity** — a pager-enabled fleet serving a
       3x-overcommitted multi-model mix under skewed traffic:
-      affinity hit-rate and cold-fault p99 gated, all bit-exact."""
+      affinity hit-rate and cold-fault p99 gated, all bit-exact.
+
+    Distributed-tracing legs (tracefleet.py): the kill's retried
+    request stitched across its two worker legs, postmortem-path
+    reconstruction from the incident file alone, >= 95% per-request
+    time attribution on tail exemplars (plain, retried, and
+    pager-cold), the offline waterfall CLI, and traced-vs-untraced
+    closed-loop throughput >= 0.95."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import shutil
     import tempfile
@@ -4667,6 +4674,16 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
             max_restarts=2, restart_backoff=0.3)
         _log(f"fleet: starting {cfg['n_workers']} workers")
         router.start(timeout=300)
+
+        # distributed tracing rides the WHOLE drill: every routed
+        # request carries a span, workers piggyback their leg on the
+        # reply, and tail sampling keeps the slowest/errored span
+        # trees for the trace-stitch leg below
+        from analytics_zoo_tpu.observability import tracefleet
+        from analytics_zoo_tpu.observability import trace as _trace_mod
+        tracer = _trace_mod.Tracer(capacity=4096, tail_quantile=0.9,
+                                   tail_cap=32)
+        router.tracer = tracer
 
         # single-process reference: SAME registry config, NO store in
         # this process — the fleet must be bit-identical to it, and
@@ -4789,6 +4806,87 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
             _log(f"fleet FAIL: worker-kill leg: "
                  f"{results['worker_kill']}")
 
+        # ---- leg B2: stitch the kill's retried request -------------
+        # a mid-flight kill leaves a span with retried=True, TWO
+        # worker_call occurrences, and only the surviving leg's
+        # piggyback — the failed occurrence attributes from the
+        # router's own measurement (worker_call_failed).  Collected
+        # here, while the ring still holds the kill-era spans.
+        import threading as _threading
+        flight = router.supervisor.flight_dir()
+
+        def _find_retried():
+            for sd in reversed(tracer.recent()):
+                if (sd.get("labels", {}).get("retried")
+                        and sd.get("children")):
+                    return sd
+            return None
+
+        retried_sd = _find_retried()
+        drill_kills = 0
+        while retried_sd is None and drill_kills < 2:
+            # leg B's window missed a mid-flight request: drill one —
+            # hammer while killing rank 0 (its restart budget is
+            # untouched; leg B's victim was the LAST rank)
+            drill_kills += 1
+            stop_flag = []
+
+            def _hammer():
+                while not stop_flag:
+                    try:
+                        router.predict("mlp", x)
+                    except Exception:  # noqa: BLE001 — drill traffic
+                        pass
+
+            ths = [_threading.Thread(target=_hammer)
+                   for _ in range(6)]
+            [t.start() for t in ths]
+            time.sleep(0.3)
+            router.supervisor.kill(0)
+            time.sleep(0.6)
+            stop_flag.append(True)
+            [t.join() for t in ths]
+            deadline_r = time.time() + 60
+            while time.time() < deadline_r:
+                if router.states().get("live") == cfg["n_workers"]:
+                    break
+                time.sleep(0.1)
+            retried_sd = _find_retried()
+
+        attr_retried = 0.0
+        retried_ok = False
+        if retried_sd is not None:
+            st_re = tracefleet.stitch(
+                retried_sd,
+                tracefleet.harvest_legs(flight,
+                                        retried_sd["trace_id"]))
+            attr_retried = st_re["attributed_fraction"]
+            retried_ok = (st_re["stitched_legs"] >= 1
+                          and st_re["monotonic"]
+                          and not st_re["partial"])
+
+        # postmortem-path reconstruction: the stitcher must work from
+        # the incident file alone (the flight dir may be gone) — join
+        # the postmortem's harvested rank spans against the ring
+        post_ok = False
+        pm_legs = []
+        if router.supervisor.postmortems:
+            try:
+                with open(router.supervisor.postmortems[-1]) as f:
+                    pm_legs = tracefleet.legs_from_postmortem(
+                        json.load(f))
+            except (OSError, ValueError):
+                pm_legs = []
+        for leg in reversed(pm_legs):
+            tid_pm = (leg.get("span") or {}).get("trace_id")
+            sd_pm = tracer.find(tid_pm) if tid_pm else None
+            if sd_pm is None:
+                continue
+            st_pm = tracefleet.assemble(tid_pm, [sd_pm], pm_legs)
+            if st_pm["stitched_legs"] >= 1 and st_pm["monotonic"]:
+                post_ok = True
+                break
+
         # ---- final explicit bit-exactness + the fleet scrape -------
         out_f = np.asarray(router.predict("mlp", x))
         bitexact = bool(np.array_equal(out_f, refs[2]))
@@ -4805,7 +4903,11 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
             required = {"zoo_fleet_workers",
                         "zoo_fleet_router_retries_total",
                         "zoo_fleet_deploy_fanout_seconds",
-                        "zoo_model_requests_total"}
+                        "zoo_model_requests_total",
+                        # the router's own tracer families ride the
+                        # pod scrape rank-labeled, exemplars included
+                        "zoo_trace_spans_total",
+                        "zoo_trace_exemplar_ms"}
             missing = sorted(required - names)
             ranked = [k for k in parsed["samples"]
                       if k[0] == "zoo_model_requests_total"
@@ -4894,8 +4996,14 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
             [t.join() for t in ts]
             return sum(counts) / secs
 
+        # the wire-hop floor is measured UNTRACED — tracing overhead
+        # has its own ratio gate in leg T below
         local_qps = closed_loop(lambda: local.predict("ref2", xw))
-        fleet_qps = closed_loop(lambda: router.predict("mlp", xw))
+        router.tracer = None
+        try:
+            fleet_qps = closed_loop(lambda: router.predict("mlp", xw))
+        finally:
+            router.tracer = tracer
         ratio = fleet_qps / max(local_qps, 1e-9)
         floor = 0.35
         g7 = ratio >= floor
@@ -4909,6 +5017,94 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
         if not g7:
             ok = False
             _log(f"fleet FAIL: throughput leg: {results['throughput']}")
+
+        # ---- leg T: exemplar attribution, CLI, tracing overhead ----
+        # per-request time attribution on the tail exemplars: router
+        # phases + the stitched worker leg + the named fleet gap must
+        # account for >= 95% of the slowest requests' wall time
+        attr_plain = 0.0
+        plain_seen = 0
+        for ex in sorted(tracer.exemplars(),
+                         key=lambda e: -e["wall_ms"]):
+            sd_p = tracer.find(ex["trace_id"])
+            if (sd_p is None or not sd_p.get("children")
+                    or sd_p.get("labels", {}).get("retried")):
+                continue
+            st_p = tracefleet.stitch(
+                sd_p, tracefleet.harvest_legs(flight,
+                                              ex["trace_id"]))
+            if st_p["stitched_legs"] >= 1 and st_p["monotonic"]:
+                attr_plain = max(attr_plain,
+                                 st_p["attributed_fraction"])
+            plain_seen += 1
+            if plain_seen >= 8 or attr_plain >= 0.99:
+                break
+
+        # the offline CLI itself, against the live artifacts
+        import contextlib as _contextlib
+        import io as _io
+        ring_path = os.path.join(work, "router_ring.json")
+        tracefleet.dump_ring(tracer, ring_path)
+        tid_cli = ((retried_sd or {}).get("trace_id")
+                   or next((e["trace_id"]
+                            for e in tracer.exemplars()), None))
+        buf = _io.StringIO()
+        with _contextlib.redirect_stdout(buf):
+            rc_list = tracefleet.main(
+                [flight, "--router", ring_path, "--list"])
+            rc_tr = (tracefleet.main(
+                [flight, "--router", ring_path,
+                 "--trace", str(tid_cli)]) if tid_cli else 1)
+        cli_ok = (rc_list == 0 and rc_tr == 0
+                  and "trace" in buf.getvalue())
+
+        # tracing must be ~free: traced vs untraced requests through
+        # the SAME closed loop (piggyback + nest included).  Window-
+        # based estimates — one traced window vs one untraced window —
+        # are hostage to box-speed drift: consecutive seconds on a
+        # shared box drift 10-25%, dwarfing the sub-1% overhead being
+        # priced, and no window ordering (sandwich, alternation, ABBA)
+        # survives step-shaped drift.  So pair at REQUEST granularity
+        # instead: each thread alternates traced/untraced per call via
+        # a thread-local tracer view, both populations ride the same
+        # milliseconds of machine, and drift cancels exactly.  The
+        # loop is latency-bound (qps = threads / mean latency), so the
+        # pooled mean-latency ratio IS the throughput ratio the gate
+        # prices.
+        _tl = _threading.local()
+        _router_cls = type(router)
+        lat_tr: list = []
+        lat_un: list = []
+        try:
+            _router_cls.tracer = property(
+                lambda s: getattr(_tl, "tr", None),
+                lambda s, v: setattr(_tl, "tr", v))
+            stop_at = time.perf_counter() + (10.0 if quick else 20.0)
+
+            def _paired(i):
+                k = i
+                while time.perf_counter() < stop_at:
+                    traced_req = (k % 2 == 0)
+                    _tl.tr = tracer if traced_req else None
+                    t0 = time.perf_counter()
+                    router.predict("mlp", xw)
+                    dt = time.perf_counter() - t0
+                    (lat_tr if traced_req else lat_un).append(dt)
+                    k += 1
+
+            pts = [_threading.Thread(target=_paired, args=(i,))
+                   for i in range(n_threads)]
+            [t.start() for t in pts]
+            [t.join() for t in pts]
+        finally:
+            del _router_cls.tracer  # plain attribute access again
+            router.tracer = tracer
+        if lat_tr and lat_un:
+            mean_tr = sum(lat_tr) / len(lat_tr)
+            mean_un = sum(lat_un) / len(lat_un)
+            ratio_t = min(mean_un / max(mean_tr, 1e-12), 1.0)
+        else:
+            ratio_t = 0.0
 
         # ---- leg E: elastic pool — warm scale-up, drained down -----
         n0 = cfg["n_workers"]
@@ -4984,10 +5180,16 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
         router = FleetRouter(
             os.path.join(work, "share"), n_workers=n_aff,
             registry_kwargs=reg_aff, env=worker_env,
+            # own run_dir: the pager fleet's flight recorders must
+            # not append into the first fleet's rank directories
+            run_dir=os.path.join(work, "run_aff"),
             max_restarts=2, restart_backoff=0.3)
         _log(f"fleet: starting {n_aff} pager workers "
              f"(budget {budget}, {n_models} models)")
         router.start(timeout=300)
+        aff_tracer = _trace_mod.Tracer(capacity=2048,
+                                       tail_quantile=0.9, tail_cap=32)
+        router.tracer = aff_tracer
         models = [f"aff{i}" for i in range(n_models)]
         aff_refs = {}
         for i, m in enumerate(models):
@@ -5048,6 +5250,56 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
         if not g10:
             ok = False
             _log(f"fleet FAIL: affinity leg: {results['affinity']}")
+
+        # ---- leg T2: pager-cold exemplar + the combined trace gate -
+        # the slowest tail exemplars of the overcommitted mix are the
+        # COLD FAULTS: the stitched worker leg must show the pager
+        # phases and still attribute the wall
+        attr_cold = 0.0
+        cold_ok = False
+        aff_flight = router.supervisor.flight_dir()
+        cold_names = {"pager_wait", "weights_h2d", "exec_rehydrate"}
+        for ex in sorted(aff_tracer.exemplars(),
+                         key=lambda e: -e["wall_ms"]):
+            sd_c = aff_tracer.find(ex["trace_id"])
+            if sd_c is None or not sd_c.get("children"):
+                continue
+            ph_c = {p[0] for ch in sd_c["children"]
+                    for p in ch.get("phases") or ()}
+            if not (ph_c & cold_names):
+                continue
+            st_c = tracefleet.stitch(
+                sd_c, tracefleet.harvest_legs(aff_flight,
+                                              ex["trace_id"]))
+            if st_c["stitched_legs"] >= 1 and st_c["monotonic"]:
+                cold_ok = True
+                attr_cold = max(attr_cold,
+                                st_c["attributed_fraction"])
+            if attr_cold >= 0.95:
+                break
+
+        g11 = (retried_ok and attr_retried >= 0.95
+               and attr_plain >= 0.95
+               and cold_ok and attr_cold >= 0.95
+               and post_ok and cli_ok and ratio_t >= 0.95)
+        results["trace_stitch"] = {
+            "attr_plain": round(attr_plain, 4),
+            "attr_retried": round(attr_retried, 4),
+            "attr_cold": round(attr_cold, 4),
+            "postmortem_stitch": post_ok, "cli_ok": cli_ok,
+            "traced_ratio": round(ratio_t, 3),
+            "drill_kills": drill_kills}
+        print("FLEET_TRACE_STITCH_" + ("OK" if g11 else "FAIL")
+              + f" attr_plain={attr_plain:.3f} "
+              f"attr_retried={attr_retried:.3f} "
+              f"attr_cold={attr_cold:.3f} "
+              f"postmortem_stitch={'y' if post_ok else 'n'} "
+              f"traced_ratio={ratio_t:.3f} "
+              f"cli={'y' if cli_ok else 'n'}", flush=True)
+        if not g11:
+            ok = False
+            _log(f"fleet FAIL: trace-stitch leg: "
+                 f"{results['trace_stitch']}")
     except (RuntimeError, OSError, KeyError, ValueError,
             subprocess.TimeoutExpired, json.JSONDecodeError) as e:
         _log(f"fleet FAIL: {type(e).__name__}: {e}")

@@ -167,7 +167,10 @@ grep -q "DENSITY_SELFCHECK_OK" <<<"$dn" || {
 # scale-up, then an autoscaler-driven scale-down mid-traffic that
 # drains the victim with zero failed requests), and residency-aware
 # routing over a 3x-overcommitted pager fleet (affinity hit-rate +
-# bounded cold-fault p99, bit-exact).
+# bounded cold-fault p99, bit-exact).  The distributed-tracing legs
+# stitch the kill's retried request across its worker legs, rebuild
+# a trace from the postmortem file alone, attribute >= 95% of the
+# tail exemplars' wall time, and bound tracing overhead.
 fl=$(timeout -k 10 590 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
     XLA_FLAGS="--xla_force_host_platform_device_count=2" \
     python bench.py fleet --quick --selfcheck)
@@ -194,6 +197,11 @@ grep -Eq "FLEET_AFFINITY_OK .*failed=0" <<<"$fl" || {
 grep -Eq "FLEET_SCALE_DOWN_OK failed=0" <<<"$fl" || {
     echo "smoke FAIL: elastic scale-down dropped requests or the" \
          "autoscaler never drove the pool" >&2
+    exit 1
+}
+grep -Eq "FLEET_TRACE_STITCH_OK .*postmortem_stitch=y" <<<"$fl" || {
+    echo "smoke FAIL: distributed-trace stitch leg missing, exemplar" \
+         "attribution under 95%, or the postmortem path broke" >&2
     exit 1
 }
 grep -q "FLEET_SELFCHECK_OK" <<<"$fl" || {

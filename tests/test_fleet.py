@@ -502,6 +502,40 @@ def test_fleet_scrape_merges_ranks_and_fleet_families(make_fleet):
     assert sum(v for v in per_rank if v is not None) == total == 4.0
 
 
+def test_distributed_trace_stitches_across_processes(make_fleet):
+    """A traced fleet request piggybacks the worker span on the reply
+    (router span gains children + fleet_gap_ms), the exemplar family
+    rides the router scrape rank-labeled, and the offline stitcher
+    reassembles the same request from the supervisor's flight dir."""
+    from analytics_zoo_tpu.observability import tracefleet
+    from analytics_zoo_tpu.observability.trace import Tracer
+    r = make_fleet(n_workers=2)
+    r.tracer = Tracer(capacity=64, tail_quantile=0.5, tail_cap=8)
+    r.deploy("m", None, STUB, builder_args={"scale": 2.0})
+    x = np.ones((1, 2))
+    infos = [r.predict_ex("m", x)[1] for _ in range(4)]
+    assert all("request_id" in info for info in infos)
+    assert any("fleet_gap_ms" in info for info in infos)
+    tid = infos[-1]["request_id"]
+    sd = r.tracer.find(tid)
+    ch = sd["children"]
+    assert len(ch) == 1 and ch[0]["tid"] == tid
+    assert ch[0]["rank"] in (0, 1) and ch[0]["phases"]
+    # the worker leg landed in that rank's flight recorder too: the
+    # offline join reproduces the inline picture from disk alone
+    flight = r.supervisor.flight_dir()
+    assert _wait(lambda: tracefleet.harvest_legs(flight, trace_id=tid))
+    st = tracefleet.stitch(sd, tracefleet.harvest_legs(flight,
+                                                       trace_id=tid))
+    assert st["stitched_legs"] == 1 and st["monotonic"]
+    assert not st["partial"]
+    assert st["attributed_fraction"] > 0.5
+    # exemplars scrape through the router, stamped rank="router"
+    text = r.metrics_text()
+    assert 'zoo_trace_spans_total{rank="router"}' in text
+    assert "zoo_trace_exemplar_ms" in text
+
+
 def test_restarted_router_never_reuses_versions(tmp_path):
     """Auto-versioning is seeded from the COMMITTED artifacts on
     disk: a second router lifetime over the same share continues the
