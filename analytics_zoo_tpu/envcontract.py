@@ -53,6 +53,9 @@ VARS: Dict[str, str] = {
     "ZOO_FLEET_MAX_FRAME": "max accepted fleet frame size in bytes (DoS guard)",
     "ZOO_TRACE_TAIL_Q": "tail-sampling retention quantile in (0,1) for exemplar traces (default 0.95; out-of-range disables)",
     "ZOO_TRACE_TAIL_CAP": "max tail-retained exemplar span trees per process (default 64)",
+    "ZOO_TRAIN_STRATEGY": "default Trainer sharding strategy (replicate|fsdp|tp|fsdp_tp); constructor arg wins",
+    "ZOO_TRAIN_ACCUM": "gradient-accumulation microbatches per optimizer step (default 1 = off)",
+    "ZOO_TRAIN_DTYPE": "training compute dtype: 'bf16' enables mixed precision (f32 master weights); default full f32",
 }
 
 

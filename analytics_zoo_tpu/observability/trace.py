@@ -60,9 +60,12 @@ PHASES = ("admission_queue", "pager_wait", "weights_h2d",
 #: the training-step phase order (train/stepprof.py; same gap-free
 #: discipline as the request chain): waiting on the prefetch queue,
 #: the host->device upload (measured on the prefetch thread and
-#: attributed to the consuming step), the compiled step dispatch, and
-#: the checkpoint save when its trigger fires.
-TRAIN_PHASES = ("data_wait", "h2d", "step_compute", "ckpt_save")
+#: attributed to the consuming step), the host-side microbatch split
+#: when gradient accumulation is on (also prefetch-thread-measured),
+#: the compiled step dispatch, and the checkpoint save when its
+#: trigger fires.
+TRAIN_PHASES = ("data_wait", "h2d", "grad_accum", "step_compute",
+                "ckpt_save")
 
 _SPAN_VAR: "contextvars.ContextVar[Optional[Span]]" = \
     contextvars.ContextVar("zoo_tpu_span", default=None)

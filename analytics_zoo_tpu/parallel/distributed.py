@@ -132,25 +132,31 @@ def is_coordinator() -> bool:
     return process_index() == 0
 
 
-def put_global(a, sharding, batch_sharded: bool = True):
+def put_global(a, sharding, batch_sharded: bool = True,
+               batch_dim: int = 0):
     """Place a host-local array onto the (possibly multi-host) mesh.
 
-    Single-process: a plain asynchronous ``device_put``.  Multi-process
-    with ``batch_sharded``: ``a`` is this host's shard of the global batch
-    (leading axis), and the global array is assembled from every process's
-    local data — the TPU-native analog of the reference's partition→core
-    feeding (net.py:458-468).  With ``batch_sharded=False`` the same
-    ``a`` must be provided by every process (replicated placement).
+    Single-process: a plain asynchronous ``device_put`` (per-shard: each
+    device's slice transfers independently, so uploads overlap compute
+    across the mesh).  Multi-process with ``batch_sharded``: ``a`` is
+    this host's shard of the global batch along ``batch_dim``, and the
+    global array is assembled from every process's local data — the
+    TPU-native analog of the reference's partition→core feeding
+    (net.py:458-468).  ``batch_dim`` is 0 for plain batches and 1 for
+    gradient-accumulation microbatch layouts (accum, micro, ...), where
+    the scanned leading axis is common to all processes.  With
+    ``batch_sharded=False`` the same ``a`` must be provided by every
+    process (replicated placement).
     """
     import jax
 
     if jax.process_count() == 1:
         return jax.device_put(a, sharding)
     if batch_sharded:
-        global_shape = (a.shape[0] * jax.process_count(),) + tuple(
-            a.shape[1:])
-        return jax.make_array_from_process_local_data(sharding, a,
-                                                      global_shape)
+        global_shape = list(a.shape)
+        global_shape[batch_dim] *= jax.process_count()
+        return jax.make_array_from_process_local_data(
+            sharding, a, tuple(global_shape))
     return jax.make_array_from_process_local_data(sharding, a,
                                                   tuple(a.shape))
 

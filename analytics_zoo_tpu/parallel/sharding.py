@@ -38,13 +38,20 @@ def fsdp_tree(params, mesh: Mesh, axis: str = "fsdp",
 
     def rule(p):
         shape = np.shape(p)
+        if len(shape) == 0:
+            # rank-0 leaf (scalar gain/temperature): nothing to shard,
+            # and np.prod(()) must never reach the size test
+            return NamedSharding(mesh, P())
         if np.prod(shape, dtype=np.int64) < min_size:
             return NamedSharding(mesh, P())
-        # largest divisible axis
+        # largest divisible axis; ties break toward the EARLIEST dim so
+        # the choice is deterministic across shape permutations (a
+        # square kernel must shard the same axis on every process — the
+        # spec is part of the checkpoint/compile contract)
         cands = [(d, i) for i, d in enumerate(shape) if d % n == 0]
         if not cands:
             return NamedSharding(mesh, P())
-        _, idx = max(cands)
+        _, idx = min(cands, key=lambda c: (-c[0], c[1]))
         spec = [None] * len(shape)
         spec[idx] = axis
         return NamedSharding(mesh, P(*spec))
@@ -121,6 +128,45 @@ def combine_spec_trees(base, overlay):
         return NamedSharding(b.mesh, P(*out))
 
     return jax.tree_util.tree_map(combine, base, overlay)
+
+
+def opt_state_sharding_tree(opt_state, params, param_shardings,
+                            mesh: Mesh):
+    """ZeRO-style optimizer-state plan: shard each moment WITH its param.
+
+    Optax states embed param-shaped copies of the parameter tree (Adam's
+    ``mu``/``nu``, momentum's ``trace``) under the parameter's own
+    subtree path; everything else (step counts, schedule scalars) is
+    housekeeping.  For every optimizer-state leaf whose tree path ENDS
+    with a parameter's path and whose shape matches, return that
+    parameter's sharding; all other leaves replicate.  The result is a
+    sharding pytree with ``opt_state``'s structure, consumable directly
+    as a ``jax.jit`` in/out sharding — the piece that turns "fsdp params"
+    into "fsdp train state" (N replicated Adam moments -> 1/N per chip).
+    """
+    repl = NamedSharding(mesh, P())
+    by_path: Dict[tuple, Any] = {}
+    p_flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    s_leaves = jax.tree_util.tree_leaves(
+        param_shardings, is_leaf=lambda l: isinstance(l, NamedSharding))
+    for (path, leaf), sh in zip(p_flat, s_leaves):
+        key = tuple(str(k) for k in path)
+        by_path[key] = (tuple(np.shape(leaf)), sh)
+
+    o_flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+    out = []
+    for path, leaf in o_flat:
+        keys = tuple(str(k) for k in path)
+        shape = tuple(np.shape(leaf))
+        sharding = repl
+        # deepest (longest) param-path suffix with a matching shape wins
+        for klen in range(len(keys), 0, -1):
+            hit = by_path.get(keys[-klen:])
+            if hit is not None and hit[0] == shape:
+                sharding = hit[1]
+                break
+        out.append(sharding)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def shard_params(params, mesh: Mesh, strategy: str = "replicate",

@@ -109,7 +109,15 @@ DEFAULT_HOT_ENTRIES = ("predict", "predict_ex", "_loop", "submit",
                        # traced/untraced throughput-ratio gate, so a
                        # stray sync or free-text log in either is
                        # exactly the overhead the gate bounds
-                       "reply_trace", "nest_summary")
+                       "reply_trace", "nest_summary",
+                       # sharded training: the compiled step body and
+                       # its gradient-accumulation scan body (traced
+                       # once, but a host sync or print there lands
+                       # INSIDE the training hot loop / the trace),
+                       # plus the prefetch-thread microbatch split that
+                       # runs once per step between h2d and dispatch
+                       "train_step", "micro_step",
+                       "_split_microbatches")
 # callees whose result is a device value mid-flight: materializing their
 # return implicitly is the ZL302 pattern
 _DISPATCHY = {"predict_fn", "dispatch_padded"}

@@ -8,13 +8,17 @@ time would not say which.  When enabled, every step carries one
 :class:`~..observability.trace.Span` over the phase chain
 (``trace.TRAIN_PHASES``)::
 
-    data_wait -> h2d -> step_compute -> ckpt_save
+    data_wait -> h2d -> grad_accum -> step_compute -> ckpt_save
 
 * ``data_wait`` — the loop thread blocked on the prefetch queue (input
   pipeline can't keep up when this dominates);
 * ``h2d`` — the host->device upload, measured ON the prefetch thread
   (it overlaps compute by design) and attributed to the consuming
   step via :meth:`Span.phase_add`;
+* ``grad_accum`` — the host-side (accum, micro, ...) microbatch split
+  when gradient accumulation is on (also prefetch-thread-measured; the
+  device-side scan itself is inside ``step_compute`` — it is ONE
+  compiled program);
 * ``step_compute`` — the compiled step dispatch; the span is ACTIVE
   here, so XLA ``backend_compile`` events (profile.py hooks) attribute
   to the exact step that paid the compile;
@@ -111,13 +115,17 @@ class StepProfiler:
             self.last_wait_s = time.perf_counter() - t0
             yield item
 
-    def begin_step(self, step: int, h2d_s: float) -> Span:
+    def begin_step(self, step: int, h2d_s: float,
+                   accum_s: float = 0.0) -> Span:
         """Open the step span with the pre-measured cross-thread
-        phases: the just-observed queue wait and the prefetch thread's
-        upload for this batch."""
+        phases: the just-observed queue wait, the prefetch thread's
+        upload for this batch, and (under gradient accumulation) its
+        host-side microbatch split."""
         span = Span(None, "train_step", labels={"step": step})
         span.phase_add("data_wait", self.last_wait_s)
         span.phase_add("h2d", h2d_s)
+        if accum_s:
+            span.phase_add("grad_accum", accum_s)
         return span
 
     def finish_step(self, span: Span, step: int) -> None:

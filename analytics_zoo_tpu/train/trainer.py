@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from .. import envcontract
 from ..common.utils import pad_leading
 from ..data.dataset import (Dataset, check_batch_divisibility,
                             prefetch_iterator, shard_batch)
@@ -94,12 +95,33 @@ def _collect_aux(state) -> Any:
 
 
 def build_train_step(model, loss_fn, optimizer, compute_dtype=None,
-                     jit: bool = True, donate: bool = True):
+                     jit: bool = True, donate: bool = True,
+                     accum_steps: int = 1, in_shardings=None,
+                     out_shardings=None):
     """THE training iteration: grad → (XLA-inserted psum when the batch is
     sharded) → optax update, with optional bf16 mixed precision (bf16
-    compute, f32 master weights; grads return f32 through the cast's
-    transpose).  Single source of truth — the Trainer, bench.py and the
-    driver dry run all compile this same function.
+    compute/activations, f32 master weights; grads return f32 through the
+    cast's transpose so the optax update — moments included — runs in
+    f32) and optional gradient accumulation.  Single source of truth —
+    the Trainer, bench.py and the driver dry run all compile this same
+    function.
+
+    ``accum_steps > 1``: ``x``/``y`` carry a LEADING microbatch axis
+    ``(accum, micro, ...)`` and the step runs a ``lax.scan`` over it
+    inside the ONE compiled program — gradients are accumulated in the
+    master dtype and averaged (mean-of-means equals the full-batch mean
+    for equal microbatches), the loss is the mean of microbatch losses,
+    and microbatch ``i`` draws ``fold_in(rng, i)`` so the per-step
+    ``fold_in(rng, step)`` determinism contract extends one level down.
+    ``accum_steps == 1`` is byte-for-byte the historical single-shot
+    step (no scan, rng consumed unsplit) so existing bit-exactness pins
+    keep holding.
+
+    ``in_shardings`` / ``out_shardings`` are forwarded to ``jax.jit`` —
+    the sharded train-state layout (params + ZeRO optimizer state +
+    batch) compiles in one pass with the whole state donated; ``None``
+    entries let jax infer from the arguments (the replicated-batch
+    fallback path stays compilable).
 
     Signature of the returned step:
         (params, model_state, opt_state, rng, x, y)
@@ -107,41 +129,107 @@ def build_train_step(model, loss_fn, optimizer, compute_dtype=None,
     """
     cast = compute_dtype
     collect_aux = _collect_aux
+    accum = max(int(accum_steps), 1)
+
+    def compute_loss(p, mstate, step_rng, x, y):
+        xin, p_in = x, p
+        if cast is not None:
+            castf = lambda a: (a.astype(cast) if jnp.issubdtype(
+                a.dtype, jnp.floating) else a)
+            xin = jax.tree_util.tree_map(castf, xin)
+            p_in = jax.tree_util.tree_map(castf, p_in)
+        y_pred, new_state = model.apply(
+            p_in, mstate, xin, training=True, rng=step_rng)
+        per_sample = loss_fn(y, y_pred.astype(jnp.float32)
+                             if cast is not None else y_pred)
+        loss = jnp.mean(per_sample) + collect_aux(new_state)
+        return loss, new_state
 
     def train_step(params, model_state, opt_state, rng, x, y):
-        def compute_loss(p):
-            xin, p_in = x, p
-            if cast is not None:
-                castf = lambda a: (a.astype(cast) if jnp.issubdtype(
-                    a.dtype, jnp.floating) else a)
-                xin = jax.tree_util.tree_map(castf, xin)
-                p_in = jax.tree_util.tree_map(castf, p_in)
-            y_pred, new_state = model.apply(
-                p_in, model_state, xin, training=True, rng=rng)
-            per_sample = loss_fn(y, y_pred.astype(jnp.float32)
-                                 if cast is not None else y_pred)
-            loss = jnp.mean(per_sample) + collect_aux(new_state)
-            return loss, new_state
+        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+        if accum == 1:
+            (loss, new_state), grads = grad_fn(params, model_state, rng,
+                                               x, y)
+        else:
+            def micro_step(carry, inp):
+                g_acc, loss_acc, mstate = carry
+                i, xi, yi = inp
+                (mloss, mstate), g = grad_fn(
+                    params, mstate, jax.random.fold_in(rng, i), xi, yi)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                return (g_acc, loss_acc + mloss, mstate), None
 
-        (loss, new_state), grads = jax.value_and_grad(
-            compute_loss, has_aux=True)(params)
+            # accumulate in the MASTER dtype (grads already left the
+            # bf16 region through the cast's transpose)
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (g_sum, loss_sum, new_state), _ = jax.lax.scan(
+                micro_step,
+                (zeros, jnp.zeros((), jnp.float32), model_state),
+                (jnp.arange(accum), x, y))
+            inv = 1.0 / accum
+            grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
+            loss = loss_sum * inv
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         return new_params, new_state, new_opt_state, loss
 
     if not jit:
         return train_step
-    return jax.jit(train_step, donate_argnums=(0, 1, 2) if donate else ())
+    kwargs = {}
+    if in_shardings is not None:
+        kwargs["in_shardings"] = in_shardings
+    if out_shardings is not None:
+        kwargs["out_shardings"] = out_shardings
+    return jax.jit(train_step, donate_argnums=(0, 1, 2) if donate else (),
+                   **kwargs)
+
+
+#: env-contract knobs (declared in envcontract.VARS): deployment-wide
+#: defaults for the sharding strategy / accumulation factor / compute
+#: dtype — explicit constructor arguments always win
+ENV_STRATEGY = "ZOO_TRAIN_STRATEGY"
+ENV_ACCUM = "ZOO_TRAIN_ACCUM"
+ENV_DTYPE = "ZOO_TRAIN_DTYPE"
+
+
+def _dtype_from_env():
+    """Resolve ``ZOO_TRAIN_DTYPE`` into a compute dtype (None = full
+    f32).  An operator typo degrades to full precision with a warning —
+    the env contract's "never crash a worker at import" rule."""
+    name = (envcontract.env_str(ENV_DTYPE) or "").strip().lower()
+    if not name:
+        return None
+    if name in ("bf16", "bfloat16"):
+        return jnp.bfloat16
+    if name in ("f16", "fp16", "float16"):
+        return jnp.float16
+    if name not in ("f32", "fp32", "float32"):
+        from ..observability.log import get_logger
+        get_logger("analytics_zoo_tpu.train").warning(
+            "unknown ZOO_TRAIN_DTYPE — training in full f32", value=name)
+    return None
 
 
 class Trainer:
     def __init__(self, model, loss_fn: Callable, optimizer,
                  metrics: Sequence = (), mesh=None,
-                 strategy: str = "replicate", seed: int = 0,
-                 compute_dtype=None):
+                 strategy: Optional[str] = None, seed: int = 0,
+                 compute_dtype=None, accum_steps: Optional[int] = None,
+                 tp_rules: Optional[Dict[str, int]] = None):
         """``model`` is any Layer (usually a GraphModule); ``loss_fn`` maps
         (y_true, y_pred) -> per-sample loss; ``optimizer`` is an optax
-        transformation."""
+        transformation.
+
+        ``strategy`` names the parameter/optimizer sharding plan
+        (``parallel/sharding.py`` rule tables: replicate | fsdp | tp |
+        fsdp_tp); ``tp_rules`` maps param-path regexes to the axis index
+        sharded over ``tensor``.  ``accum_steps`` > 1 splits every global
+        batch into that many microbatches scanned inside the one
+        compiled step.  ``compute_dtype=jnp.bfloat16`` enables mixed
+        precision (bf16 compute, f32 master weights + moments).  Each of
+        strategy / accum_steps / compute_dtype falls back to its env
+        knob (ZOO_TRAIN_STRATEGY / ZOO_TRAIN_ACCUM / ZOO_TRAIN_DTYPE)
+        when not given."""
         self.model = model
         self.loss_fn = loss_fn
         # the optimizer actually stepped is the base masked by the
@@ -150,9 +238,14 @@ class Trainer:
         self.optimizer = self._mask_from_flags(optimizer)
         self.metrics = list(metrics)
         self.mesh = mesh or mesh_lib.get_default_mesh()
-        self.strategy = strategy
+        self.strategy = strategy or envcontract.env_str(
+            ENV_STRATEGY, "replicate")
+        self.tp_rules = dict(tp_rules) if tp_rules else None
+        self.accum_steps = max(int(accum_steps) if accum_steps is not None
+                               else envcontract.env_int(ENV_ACCUM, 1), 1)
         self.seed = seed
-        self.compute_dtype = compute_dtype
+        self.compute_dtype = (compute_dtype if compute_dtype is not None
+                              else _dtype_from_env())
         self.state: Optional[TrainState] = None
         self.train_summary: Optional[TrainSummary] = None
         self.val_summary: Optional[ValidationSummary] = None
@@ -161,7 +254,13 @@ class Trainer:
         self._eval_step_overrides: Dict[str, Any] = {}
         self._predict_step = None
         self._param_shardings = None
+        self._opt_shardings = None
         self._batch_sharding = mesh_lib.data_sharding(self.mesh)
+        # microbatched layout (accum, micro, ...): the data axes move to
+        # dim 1, the scanned accumulation axis stays unsharded
+        self._microbatch_sharding = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(
+                None, *self._batch_sharding.spec))
         self._repl_sharding = mesh_lib.replicated(self.mesh)
 
     # ---- freeze support --------------------------------------------
@@ -238,7 +337,7 @@ class Trainer:
         # state alongside params, ZeRO-style) — init-before-placement
         # would pin momentum to one device and conflict after a restore.
         self._param_shardings = sharding_lib.shard_params(
-            params, self.mesh, self.strategy)
+            params, self.mesh, self.strategy, tp_rules=self.tp_rules)
         params = jax.tree_util.tree_map(
             lambda p, s: jax.device_put(p, s), params, self._param_shardings)
         model_state = jax.device_put(model_state, self._repl_sharding)
@@ -272,7 +371,7 @@ class Trainer:
                 "adopted weights do not match the model's parameter "
                 "structure (did the architecture change?)")
         self._param_shardings = sharding_lib.shard_params(
-            abs_params, self.mesh, self.strategy)
+            abs_params, self.mesh, self.strategy, tp_rules=self.tp_rules)
         placed = jax.tree_util.tree_map(
             lambda p, s: jax.device_put(p, s), params,
             self._param_shardings)
@@ -306,9 +405,34 @@ class Trainer:
                 return fn(*a, **k)
         return wrapped
 
+    def _state_plan(self):
+        """The declarative sharded train-state layout: explicit jit
+        shardings over (params, model_state, opt_state, rng) — params per
+        the strategy rule tables, optimizer state WITH its params
+        (ZeRO-style, ``sharding.opt_state_sharding_tree``), model state
+        and rng replicated.  Batch entries stay ``None`` (inferred from
+        the placed arguments) so the replicated-batch fallback path keeps
+        compiling.  Returns ``(in_shardings, out_shardings)`` for
+        ``build_train_step``."""
+        st = self.state
+        self._opt_shardings = sharding_lib.opt_state_sharding_tree(
+            st.opt_state, st.params, self._param_shardings, self.mesh)
+        # model_state as a PREFIX (one sharding covers the whole
+        # subtree): training-mode state may grow keys (aux_loss) the
+        # init-time structure doesn't have
+        in_sh = (self._param_shardings, self._repl_sharding,
+                 self._opt_shardings, self._repl_sharding, None, None)
+        out_sh = (self._param_shardings, self._repl_sharding,
+                  self._opt_shardings, None)
+        return in_sh, out_sh
+
     def _build_train_step(self):
+        self.ensure_initialized()
+        in_sh, out_sh = self._state_plan()
         return build_train_step(self.model, self.loss_fn, self.optimizer,
-                                compute_dtype=self.compute_dtype)
+                                compute_dtype=self.compute_dtype,
+                                accum_steps=self.accum_steps,
+                                in_shardings=in_sh, out_shardings=out_sh)
 
     def _build_eval_step(self, metrics: Optional[Sequence] = None):
         model = self.model
@@ -359,15 +483,44 @@ class Trainer:
     # ------------------------------------------------------------------
     _warned_replicated = False
 
-    def _put_batch(self, x, y):
-        """Place a host-local batch onto the mesh.  Multi-host: ``x``/``y``
-        are this host's shard of the global batch and every process's
-        shards are assembled into one global array (per-host feeding,
-        reference net.py:458-468)."""
+    def _split_microbatches(self, x, y):
+        """Host-side (accum, micro, ...) view of a batch — a zero-copy
+        numpy reshape on the prefetch thread, attributed to the
+        ``grad_accum`` profiler phase by the caller.  The scanned
+        accumulation axis leads; the data axes shard dim 1."""
+        accum = self.accum_steps
+
+        def split(a):
+            a = np.asarray(a)
+            if a.shape[0] % accum:
+                raise ValueError(
+                    f"per-host batch ({a.shape[0]}) must divide "
+                    f"accum_steps ({accum})")
+            return a.reshape((accum, a.shape[0] // accum) + a.shape[1:])
+
+        sx = (tuple(split(a) for a in x) if isinstance(x, (tuple, list))
+              else split(x))
+        if y is None:
+            return sx, None
+        sy = (tuple(split(a) for a in y) if isinstance(y, (tuple, list))
+              else split(y))
+        return sx, sy
+
+    def _put_batch(self, x, y, microbatched: bool = False):
+        """Place a host-local batch onto the mesh, per-shard: the
+        ``device_put``/``make_array_from_process_local_data`` under
+        ``put_global`` transfers each device's slice independently (and
+        asynchronously), so upload overlaps compute across the mesh.
+        Multi-host: ``x``/``y`` are this host's shard of the global batch
+        and every process's shards are assembled into one global array
+        (per-host feeding, reference net.py:458-468).  ``microbatched``
+        batches arrive pre-split as (accum, micro, ...) — the data axes
+        shard dim 1 and cross-process assembly concatenates there."""
         first = x[0] if isinstance(x, (tuple, list)) else x
+        batch_dim = 1 if microbatched else 0
         dp = mesh_lib.dp_size(self.mesh)
         nproc = dist_lib.process_count()
-        global_rows = len(first) * nproc
+        global_rows = np.shape(first)[batch_dim] * nproc
         divisible = global_rows % max(dp, 1) == 0
         if not divisible and nproc > 1:
             raise ValueError(
@@ -381,10 +534,15 @@ class Trainer:
                 "batch does not divide the data-parallel degree — "
                 "falling back to replicated compute (every device runs "
                 "the full batch). Pad the batch for full speed.",
-                batch=len(first), data_parallel=dp)
-        sharding = self._batch_sharding if divisible else self._repl_sharding
+                batch=np.shape(first)[batch_dim], data_parallel=dp)
+        if divisible:
+            sharding = (self._microbatch_sharding if microbatched
+                        else self._batch_sharding)
+        else:
+            sharding = self._repl_sharding
         put = lambda a: dist_lib.put_global(a, sharding,
-                                            batch_sharded=divisible)
+                                            batch_sharded=divisible,
+                                            batch_dim=batch_dim)
         xs = (tuple(put(a) for a in x) if isinstance(x, (tuple, list))
               else put(x))
         if y is None:
@@ -520,6 +678,11 @@ class Trainer:
         check_batch_divisibility(batch_size, mesh_lib.dp_size(self.mesh),
                                  dist_lib.process_count())
         per_host_bs = batch_size // dist_lib.process_count()
+        if per_host_bs % self.accum_steps:
+            raise ValueError(
+                f"per-host batch ({per_host_bs}) must divide "
+                f"accum_steps ({self.accum_steps}) — every microbatch "
+                "keeps one compiled shape")
         end_trigger = end_trigger or trigger_lib.MaxEpoch(
             self.state.epoch + 1)
         validation_trigger = validation_trigger or trigger_lib.EveryEpoch()
@@ -573,16 +736,28 @@ class Trainer:
                     batch_it = itertools.islice(batch_it, resume_skip,
                                                 None)
                     resume_skip = 0
+                accum = self.accum_steps
                 if prof is None:
-                    put_fn = lambda b: self._put_batch(*b)
+                    if accum == 1:
+                        put_fn = lambda b: self._put_batch(*b)
+                    else:
+                        put_fn = lambda b: self._put_batch(
+                            *self._split_microbatches(*b),
+                            microbatched=True)
                 else:
                     def put_fn(b):
-                        # h2d measured ON the prefetch thread, shipped
-                        # with the batch so the consuming step's span
-                        # can attribute it
+                        # grad_accum (host microbatch split) and h2d
+                        # measured ON the prefetch thread, shipped with
+                        # the batch so the consuming step's span can
+                        # attribute them
+                        accum_s = 0.0
+                        if accum > 1:
+                            t0 = time.perf_counter()
+                            b = self._split_microbatches(*b)
+                            accum_s = time.perf_counter() - t0
                         t0 = time.perf_counter()
-                        out = self._put_batch(*b)
-                        return out, time.perf_counter() - t0
+                        out = self._put_batch(*b, microbatched=accum > 1)
+                        return out, time.perf_counter() - t0, accum_s
                 dev_it = prefetch_iterator(batch_it, put_fn)
                 step_it = (dev_it if prof is None
                            else prof.timed_iter(dev_it))
@@ -591,8 +766,9 @@ class Trainer:
                         bx, by = item
                         span = None
                     else:
-                        (bx, by), h2d_s = item
-                        span = prof.begin_step(st.step + 1, h2d_s)
+                        (bx, by), h2d_s, accum_s = item
+                        span = prof.begin_step(st.step + 1, h2d_s,
+                                               accum_s=accum_s)
                     step_rng = jax.random.fold_in(st.rng, st.step)
                     if span is None:
                         st.params, st.model_state, st.opt_state, loss = \

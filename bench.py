@@ -5322,6 +5322,268 @@ def fleet_bench(quick: bool = False, selfcheck: bool = False,
     return 0 if (ok or not selfcheck) else 1
 
 
+def _write_trainshard_trajectory(results: dict, rc: int) -> str:
+    """Append this run to the BENCH_TRAINSHARD_r*.json trajectory (same
+    shape as the driver's BENCH_r*.json files: n / cmd / rc / parsed)
+    so sharded-training baselines accumulate across PRs."""
+    import re as _re
+
+    ns = []
+    for p in glob.glob(os.path.join(REPO, "BENCH_TRAINSHARD_r*.json")):
+        m = _re.search(r"BENCH_TRAINSHARD_r(\d+)\.json$", p)
+        if m:
+            ns.append(int(m.group(1)))
+    n = max(ns, default=0) + 1
+    path = os.path.join(REPO, f"BENCH_TRAINSHARD_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"n": n,
+                   "cmd": "python bench.py trainshard "
+                          + " ".join(sys.argv[2:]),
+                   "rc": rc, "parsed": results}, f, indent=2)
+    return path
+
+
+def trainshard_bench(quick: bool = False, selfcheck: bool = False,
+                     out_path: str = None) -> int:
+    """Sharded-training correctness + efficiency gates (``bench.py
+    trainshard``), on forced host devices:
+
+    * ``TRAINSHARD_BITEXACT`` — f32, accum=1: the fsdp leg's loss
+      trajectory tracks the replicated leg within 1e-5 relative and
+      final params within 1e-6 (a row-sharded kernel splits even the
+      forward contraction into partial sums, so GSPMD re-associates at
+      the ulp level); the fsdp_tp column-split leg is fully BITWISE,
+      losses and params included (gather-only partitioning
+      re-associates nothing);
+    * ``TRAINSHARD_ACCUM`` — accum=2 reproduces the accum=1 trajectory
+      within per-dtype tolerance (f32 1e-5 rel; bf16 leg finite and
+      within 5e-2 of its f32 twin);
+    * ``TRAINSHARD_COMPILES`` — exactly ONE backend_compile lands in
+      the profiled traffic window: the sharded layout never re-traces
+      or reshards per step (epoch 2 reuses epoch 1's executable);
+    * ``TRAINSHARD_OPTBYTES`` — device-0 optimizer-state bytes under
+      fsdp strictly below the replicated layout (the ZeRO win,
+      measured from actual shard layouts);
+    * ``TRAINSHARD_SCALING`` (full runs only) — weak scaling: per-chip
+      step rate on the 2-device mesh at least 0.35x the 1-device mesh
+      (interleaved best-pair, same per-chip batch).
+    """
+    import gc
+
+    import numpy as np
+    import optax
+    import jax
+
+    from analytics_zoo_tpu.data.dataset import Dataset
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
+    from analytics_zoo_tpu.pipeline.api.keras import (Sequential,
+                                                      objectives)
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.train import triggers
+    from analytics_zoo_tpu.train.trainer import Trainer
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        print("BENCH_TRAINSHARD "
+              + json.dumps({"error": "needs >= 2 devices"}), flush=True)
+        return 1
+    steps = 4 if quick else 8
+    rows, dim, classes, batch = 64, 8, 10, 32
+    results = {"quick": quick, "steps": steps,
+               "n_devices": len(devices)}
+    ok = True
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(rows, dim).astype(np.float32)
+    y = rs.randint(0, classes, rows).astype(np.int32)
+
+    def make_trainer(mesh, strategy, width=4096, **kw):
+        m = Sequential()
+        # explicit names: every leg's param tree flattens identically
+        m.add(Dense(width, activation="relu", input_shape=(dim,),
+                    name="hid"))
+        m.add(Dense(classes, name="out"))
+        return Trainer(
+            m.to_graph(),
+            objectives.get("sparse_categorical_crossentropy"),
+            optax.adam(1e-3), mesh=mesh, strategy=strategy, seed=0,
+            **kw)
+
+    def fit_losses(t, n=steps, data=None, bs=batch):
+        ds = Dataset.from_ndarray(*(data or (x, y)))
+        return t.fit(ds, batch_size=bs,
+                     end_trigger=triggers.MaxIteration(n))["loss"]
+
+    def rel_err(a, b):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        return float(np.max(np.abs(a - b)
+                            / np.maximum(np.abs(b), 1e-12)))
+
+    def params_of(t):
+        return [np.asarray(l) for l in
+                jax.tree_util.tree_leaves(t.state.params)]
+
+    def dev0_opt_bytes(t):
+        total = 0
+        for l in jax.tree_util.tree_leaves(t.state.opt_state):
+            if isinstance(l, jax.Array) and l.addressable_shards:
+                total += l.addressable_shards[0].data.nbytes
+        return total
+
+    from jax.sharding import PartitionSpec as _P
+
+    # ---------------------------------------------- bitexact (f32)
+    mesh_f = mesh_lib.create_mesh({"data": 1, "fsdp": 2}, devices[:2])
+    t_rep = make_trainer(mesh_f, "replicate")
+    l_rep = fit_losses(t_rep)
+    t_fsdp = make_trainer(mesh_f, "fsdp")
+    l_fsdp = fit_losses(t_fsdp)
+    fsdp_sharded = any(
+        l.sharding.spec != _P()
+        for l in jax.tree_util.tree_leaves(t_fsdp.state.params))
+    fsdp_traj_rel = rel_err(l_fsdp, l_rep)
+    fsdp_par_max = max(
+        float(np.max(np.abs(a - b)))
+        for a, b in zip(params_of(t_rep), params_of(t_fsdp)))
+
+    mesh_tp = mesh_lib.create_mesh(
+        {"data": 1, "fsdp": 1, "tensor": 2}, devices[:2])
+    t_rep_tp = make_trainer(mesh_tp, "replicate")
+    l_rep_tp = fit_losses(t_rep_tp)
+    t_tp = make_trainer(mesh_tp, "fsdp_tp", tp_rules={r"W$": 1})
+    l_tp = fit_losses(t_tp)
+    tp_sharded = any(
+        l.sharding.spec != _P()
+        for l in jax.tree_util.tree_leaves(t_tp.state.params))
+    tp_bit = (l_rep_tp == l_tp and all(
+        np.array_equal(a, b)
+        for a, b in zip(params_of(t_rep_tp), params_of(t_tp))))
+
+    g1 = (fsdp_traj_rel <= 1e-5 and fsdp_par_max <= 1e-6 and tp_bit
+          and fsdp_sharded and tp_sharded)
+    results["bitexact"] = {
+        "fsdp_traj_rel": fsdp_traj_rel,
+        "fsdp_params_maxabs": fsdp_par_max, "tp_bitwise": tp_bit,
+        "fsdp_sharded": fsdp_sharded, "tp_sharded": tp_sharded}
+    print("TRAINSHARD_BITEXACT "
+          f"fsdp_traj_rel={fsdp_traj_rel:.2e} "
+          f"fsdp_params_maxabs={fsdp_par_max:.2e} "
+          f"tp={'bit' if tp_bit else 'DIFF'}", flush=True)
+    if not g1:
+        ok = False
+        _log(f"trainshard FAIL: bitexact: {results['bitexact']}")
+
+    # -------------------------------------------------------- accum
+    t_acc = make_trainer(mesh_f, "fsdp", accum_steps=2)
+    l_acc = fit_losses(t_acc)
+    accum_rel = rel_err(l_acc, l_fsdp)
+    import jax.numpy as jnp
+    t_bf = make_trainer(mesh_f, "fsdp", accum_steps=2,
+                        compute_dtype=jnp.bfloat16)
+    l_bf = fit_losses(t_bf)
+    bf16_rel = rel_err(l_bf, l_acc)
+    bf16_finite = bool(np.all(np.isfinite(l_bf)))
+    g2 = accum_rel <= 1e-5 and bf16_finite and bf16_rel <= 5e-2
+    results["accum"] = {"f32_rel": accum_rel, "bf16_rel": bf16_rel,
+                        "bf16_finite": bf16_finite}
+    print(f"TRAINSHARD_ACCUM f32_rel={accum_rel:.2e} "
+          f"bf16_rel={bf16_rel:.2e}", flush=True)
+    if not g2:
+        ok = False
+        _log(f"trainshard FAIL: accum: {results['accum']}")
+
+    # ----------------------------------------------------- compiles
+    t_c = make_trainer(mesh_f, "fsdp", accum_steps=2)
+    prof = t_c.enable_step_profiler()
+    fit_losses(t_c)  # >= 2 epochs: epoch 2 must reuse the executable
+    compiles = prof.compiles
+    g3 = compiles == 1
+    results["compiles"] = compiles
+    print(f"TRAINSHARD_COMPILES={compiles}", flush=True)
+    if not g3:
+        ok = False
+        _log(f"trainshard FAIL: {compiles} compiles in the traffic "
+             "window (want exactly 1)")
+
+    # ----------------------------------------------------- optbytes
+    fsdp_bytes = dev0_opt_bytes(t_fsdp)
+    repl_bytes = dev0_opt_bytes(t_rep)
+    g4 = 0 < fsdp_bytes < repl_bytes
+    results["optbytes"] = {"fsdp_dev0": fsdp_bytes,
+                           "replicated_dev0": repl_bytes}
+    print(f"TRAINSHARD_OPTBYTES fsdp={fsdp_bytes} "
+          f"replicated={repl_bytes}", flush=True)
+    if not g4:
+        ok = False
+        _log(f"trainshard FAIL: optbytes: {results['optbytes']}")
+
+    # ------------------------------------------- scaling (full only)
+    if not quick:
+        sdim, swidth, sbatch, srows = 256, 1024, 64, 256
+        rs2 = np.random.RandomState(1)
+        sx = rs2.randn(srows, sdim).astype(np.float32)
+        sy = rs2.randint(0, classes, srows).astype(np.int32)
+        mesh1 = mesh_lib.create_mesh({"data": 1}, devices[:1])
+        mesh2 = mesh_lib.create_mesh({"data": 2}, devices[:2])
+
+        def scale_trainer(mesh):
+            m = Sequential()
+            m.add(Dense(swidth, activation="relu",
+                        input_shape=(sdim,), name="hid"))
+            m.add(Dense(classes, name="out"))
+            return Trainer(
+                m.to_graph(),
+                objectives.get("sparse_categorical_crossentropy"),
+                optax.adam(1e-3), mesh=mesh, strategy="replicate",
+                seed=0)
+
+        sds = Dataset.from_ndarray(sx, sy)
+        t1 = scale_trainer(mesh1)
+        t2 = scale_trainer(mesh2)
+        t1.ensure_initialized()  # state exists before .step is read
+        t2.ensure_initialized()
+        k = 8  # timed steps per round; same PER-CHIP batch both legs
+        # warmup: compile + first dispatches off the clock
+        t1.fit(sds, batch_size=sbatch,
+               end_trigger=triggers.MaxIteration(t1.state.step + 2))
+        t2.fit(sds, batch_size=2 * sbatch,
+               end_trigger=triggers.MaxIteration(t2.state.step + 2))
+        best1 = best2 = 0.0
+        for _ in range(3):  # interleaved best-pair
+            gc.collect()
+            t0 = time.perf_counter()
+            t1.fit(sds, batch_size=sbatch,
+                   end_trigger=triggers.MaxIteration(t1.state.step + k))
+            best1 = max(best1, k / (time.perf_counter() - t0))
+            gc.collect()
+            t0 = time.perf_counter()
+            t2.fit(sds, batch_size=2 * sbatch,
+                   end_trigger=triggers.MaxIteration(t2.state.step + k))
+            best2 = max(best2, k / (time.perf_counter() - t0))
+        ratio = best2 / max(best1, 1e-12)
+        g5 = ratio >= 0.35
+        results["scaling"] = {"steps_per_s_1dev": round(best1, 3),
+                              "steps_per_s_2dev": round(best2, 3),
+                              "per_chip_fraction": round(ratio, 4)}
+        print(f"TRAINSHARD_SCALING per_chip_fraction={ratio:.3f} "
+              f"rate1={best1:.2f}/s rate2={best2:.2f}/s", flush=True)
+        if not g5:
+            ok = False
+            _log(f"trainshard FAIL: scaling: {results['scaling']}")
+
+    rc = 0 if (ok or not selfcheck) else 1
+    print("BENCH_TRAINSHARD " + json.dumps(results), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+    if not quick:
+        _write_trainshard_trajectory(results, rc)
+    if selfcheck:
+        print("TRAINSHARD_SELFCHECK_" + ("OK" if ok else "FAIL"),
+              flush=True)
+    return rc
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child(sys.argv[2] if len(sys.argv) > 2 else "tpu")
@@ -5427,6 +5689,22 @@ if __name__ == "__main__":
         sys.exit(sharded_bench(quick="--quick" in sys.argv,
                                selfcheck="--selfcheck" in sys.argv,
                                out_path=_out))
+    elif len(sys.argv) > 1 and sys.argv[1] == "trainshard":
+        # bit-exactness is a HOST-device contract: pin the cpu platform
+        # and force 2 virtual devices BEFORE jax initializes (no-op
+        # when the caller — the smoke script — already set a count)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+        _out = None
+        if "--out" in sys.argv:
+            _out = sys.argv[sys.argv.index("--out") + 1]
+        sys.exit(trainshard_bench(quick="--quick" in sys.argv,
+                                  selfcheck="--selfcheck" in sys.argv,
+                                  out_path=_out))
     elif len(sys.argv) > 1 and sys.argv[1] == "loadtest":
         # the elastic gates need >1 device: force 2 virtual host
         # devices BEFORE jax initializes (no-op when the caller — the

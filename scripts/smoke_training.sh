@@ -35,4 +35,27 @@ grep -q "FAULTTRAIN_SELFCHECK_OK" <<<"$ft" || {
     echo "smoke FAIL: faulttrain selfcheck gates failed" >&2
     exit 1
 }
+
+# Sharded-training gates: the pjit train-state layout on 2 forced host
+# devices — fsdp/fsdp_tp numerics vs replicated, gradient accumulation,
+# exactly one compile in the traffic window, and the ZeRO opt-state
+# memory win (bench.py trainshard --quick --selfcheck).
+ts=$(timeout -k 10 900 env JAX_PLATFORMS=cpu PYTHONPATH="$PWD" \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    python bench.py trainshard --quick --selfcheck)
+printf '%s\n' "$ts"
+grep -q "TRAINSHARD_BITEXACT" <<<"$ts" || {
+    echo "smoke FAIL: trainshard never reached the sharded-vs-" \
+         "replicated numerics gate" >&2
+    exit 1
+}
+grep -q "TRAINSHARD_COMPILES=1" <<<"$ts" || {
+    echo "smoke FAIL: the sharded train step did not compile exactly" \
+         "once in the traffic window" >&2
+    exit 1
+}
+grep -q "TRAINSHARD_SELFCHECK_OK" <<<"$ts" || {
+    echo "smoke FAIL: trainshard selfcheck gates failed" >&2
+    exit 1
+}
 echo "training smoke OK"

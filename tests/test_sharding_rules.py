@@ -1,0 +1,198 @@
+"""Sharding rule tables: fsdp axis selection, spec-tree combination,
+and the ZeRO-style optimizer-state plan.
+
+These pin the *contract* side of the sharded-trainer work: the specs a
+rule table emits are part of the checkpoint/compile contract, so the
+tie-breaks and merge semantics must be deterministic and stay put.
+"""
+
+import numpy as np
+import optax
+import jax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+from analytics_zoo_tpu.parallel.sharding import (
+    combine_spec_trees, fsdp_tree, opt_state_sharding_tree,
+    replicated_tree, shard_params, tensor_parallel_tree)
+
+
+@pytest.fixture(scope="module")
+def fsdp2_mesh():
+    return mesh_lib.create_mesh({"data": 4, "fsdp": 2})
+
+
+@pytest.fixture(scope="module")
+def full_mesh():
+    return mesh_lib.create_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+
+
+def _spec(tree, *path):
+    node = tree
+    for k in path:
+        node = node[k]
+    return node.spec
+
+
+# ---------------------------------------------------------------- fsdp
+
+
+def test_fsdp_picks_largest_divisible_axis(fsdp2_mesh):
+    params = {"w": np.zeros((64, 256, 2), np.float32)}
+    tree = fsdp_tree(params, fsdp2_mesh, min_size=1)
+    assert _spec(tree, "w") == P(None, "fsdp", None)
+
+
+def test_fsdp_tie_breaks_toward_earliest_dim(fsdp2_mesh):
+    """Equal-size candidate dims must resolve to the EARLIEST index —
+    the spec for a square kernel is part of the checkpoint/compile
+    contract and may not depend on enumeration quirks."""
+    params = {"sq": np.zeros((128, 128), np.float32),
+              "cube": np.zeros((4, 64, 64), np.float32)}
+    tree = fsdp_tree(params, fsdp2_mesh, min_size=1)
+    assert _spec(tree, "sq") == P("fsdp", None)
+    # first dim (4) is divisible but smaller; the 64-tie resolves to
+    # the earlier of the two 64s
+    assert _spec(tree, "cube") == P(None, "fsdp", None)
+
+
+def test_fsdp_prefers_size_over_position(fsdp2_mesh):
+    """(64, 128) and (128, 64) shard their 128 dim, wherever it sits."""
+    tree = fsdp_tree({"a": np.zeros((64, 128), np.float32),
+                      "b": np.zeros((128, 64), np.float32)},
+                     fsdp2_mesh, min_size=1)
+    assert _spec(tree, "a") == P(None, "fsdp")
+    assert _spec(tree, "b") == P("fsdp", None)
+
+
+def test_fsdp_rank0_and_small_leaves_replicate(fsdp2_mesh):
+    params = {"gain": np.float32(3.0),          # rank-0: early return
+              "tiny": np.zeros((8,), np.float32)}  # below min_size
+    tree = fsdp_tree(params, fsdp2_mesh, min_size=16)
+    assert _spec(tree, "gain") == P()
+    assert _spec(tree, "tiny") == P()
+    # rank-0 replicates even when min_size can't save it
+    zero = fsdp_tree({"gain": np.float32(1.0)}, fsdp2_mesh, min_size=0)
+    assert _spec(zero, "gain") == P()
+
+
+def test_fsdp_no_divisible_axis_replicates(fsdp2_mesh):
+    tree = fsdp_tree({"odd": np.zeros((3, 5), np.float32)},
+                     fsdp2_mesh, min_size=1)
+    assert _spec(tree, "odd") == P()
+
+
+def test_fsdp_absent_or_unit_axis_replicates_all():
+    mesh = mesh_lib.create_mesh({"data": 8})
+    tree = fsdp_tree({"w": np.zeros((64, 64), np.float32)}, mesh,
+                     min_size=1)
+    assert _spec(tree, "w") == P()
+
+
+# --------------------------------------------------- combine_spec_trees
+
+
+def test_combine_fsdp_and_tp_on_same_kernel(full_mesh):
+    """The headline merge: fsdp on dim 0 + tensor on dim 1 of ONE Dense
+    kernel become P('fsdp', 'tensor'), not either/or."""
+    base = {"W": NamedSharding(full_mesh, P("fsdp", None))}
+    over = {"W": NamedSharding(full_mesh, P(None, "tensor"))}
+    out = combine_spec_trees(base, over)
+    assert _spec(out, "W") == P("fsdp", "tensor")
+
+
+def test_combine_collision_drops_base_axis(full_mesh):
+    """A PartitionSpec may not name one mesh axis twice: when the
+    overlay consumed the axis the base wanted, the base dim goes
+    unsharded rather than producing an invalid spec."""
+    base = {"W": NamedSharding(full_mesh, P("tensor", None))}
+    over = {"W": NamedSharding(full_mesh, P(None, "tensor"))}
+    out = combine_spec_trees(base, over)
+    assert _spec(out, "W") == P(None, "tensor")
+
+
+def test_combine_pads_mismatched_rank_specs(full_mesh):
+    base = {"W": NamedSharding(full_mesh, P("fsdp"))}
+    over = {"W": NamedSharding(full_mesh, P(None, "tensor"))}
+    out = combine_spec_trees(base, over)
+    assert _spec(out, "W") == P("fsdp", "tensor")
+    # symmetric: short overlay against a longer base
+    out2 = combine_spec_trees(
+        {"W": NamedSharding(full_mesh, P(None, "fsdp"))},
+        {"W": NamedSharding(full_mesh, P("tensor"))})
+    assert _spec(out2, "W") == P("tensor", "fsdp")
+
+
+def test_combine_empty_side_passes_other_through(full_mesh):
+    fs = NamedSharding(full_mesh, P("fsdp", None))
+    repl = NamedSharding(full_mesh, P())
+    assert combine_spec_trees({"a": fs}, {"a": repl})["a"].spec \
+        == P("fsdp", None)
+    assert combine_spec_trees({"a": repl}, {"a": fs})["a"].spec \
+        == P("fsdp", None)
+
+
+def test_shard_params_fsdp_tp_end_to_end(full_mesh):
+    """strategy='fsdp_tp' on a Dense-shaped tree: the kernel merges both
+    axes, the bias follows only the rules that fit it."""
+    params = {"dense": {"W": np.zeros((256, 128), np.float32),
+                        "b": np.zeros((128,), np.float32)}}
+    tree = shard_params(params, full_mesh, "fsdp_tp",
+                        tp_rules={r"W$": 1}, fsdp_min_size=1)
+    assert _spec(tree, "dense", "W") == P("fsdp", "tensor")
+    assert _spec(tree, "dense", "b") == P("fsdp")
+
+
+def test_tensor_rules_skip_non_divisible_dims(full_mesh):
+    params = {"W": np.zeros((6, 7), np.float32)}
+    tree = tensor_parallel_tree(params, full_mesh, {r"W$": 1})
+    assert _spec(tree, "W") == P()  # 7 % 2 != 0 -> replicated
+
+
+# ------------------------------------------------ opt_state_sharding
+
+
+def test_opt_state_moments_follow_their_params(fsdp2_mesh):
+    params = {"dense": {"W": np.zeros((256, 128), np.float32),
+                        "b": np.zeros((128,), np.float32)}}
+    shardings = fsdp_tree(params, fsdp2_mesh, min_size=1)
+    opt_state = optax.adam(1e-3).init(params)
+    plan = opt_state_sharding_tree(opt_state, params, shardings,
+                                   fsdp2_mesh)
+    flat = jax.tree_util.tree_flatten_with_path(plan)[0]
+    by_path = {"/".join(str(k) for k in path): sh.spec
+               for path, sh in flat}
+    mu_w = [s for p, s in by_path.items()
+            if ".mu" in p and "'W'" in p]
+    nu_w = [s for p, s in by_path.items()
+            if ".nu" in p and "'W'" in p]
+    counts = [s for p, s in by_path.items() if ".count" in p]
+    assert mu_w == [P("fsdp", None)]
+    assert nu_w == [P("fsdp", None)]
+    assert counts and all(s == P() for s in counts)
+
+
+def test_opt_state_shape_mismatch_replicates(fsdp2_mesh):
+    """A leaf whose path matches a param suffix but whose SHAPE does not
+    (a schedule buffer named like the param) must replicate, never
+    inherit a spec its shape can't satisfy."""
+    params = {"W": np.zeros((256, 128), np.float32)}
+    shardings = fsdp_tree(params, fsdp2_mesh, min_size=1)
+    fake_state = {"mu": {"W": np.zeros((256, 128), np.float32)},
+                  "buf": {"W": np.zeros((3,), np.float32)}}
+    plan = opt_state_sharding_tree(fake_state, params, shardings,
+                                   fsdp2_mesh)
+    assert plan["mu"]["W"].spec == P("fsdp", None)
+    assert plan["buf"]["W"].spec == P()
+
+
+def test_opt_state_replicated_params_replicate_everything(fsdp2_mesh):
+    params = {"W": np.zeros((64, 64), np.float32)}
+    shardings = replicated_tree(params, fsdp2_mesh)
+    opt_state = optax.sgd(0.1, momentum=0.9).init(params)
+    plan = opt_state_sharding_tree(opt_state, params, shardings,
+                                   fsdp2_mesh)
+    assert all(sh.spec == P()
+               for sh in jax.tree_util.tree_leaves(
+                   plan, is_leaf=lambda l: isinstance(l, NamedSharding)))
